@@ -1,0 +1,45 @@
+"""deequ_tpu: a TPU-native data-quality framework.
+
+Declarative "unit tests for data" with the capabilities of the reference
+(deequ @ /root/reference): checks/constraints over tabular data, a metrics
+engine built on mergeable sufficient statistics, single-pass scan-shared
+metric computation, approximate sketches, a three-pass column profiler,
+constraint suggestion, metric repositories and anomaly detection.
+
+Execution engine: JAX/XLA. Columnar batches stream to device; all requested
+analyzers lower to one fused masked-reduction computation per pass
+(the analogue of the reference's Catalyst scan sharing,
+reference: analyzers/runners/AnalysisRunner.scala:98-193), and the semigroup
+state merge (reference: analyzers/Analyzer.scala:34-48) maps to collective
+reductions across a TPU mesh.
+"""
+
+from deequ_tpu.core.maybe import Try, Success, Failure
+from deequ_tpu.core.metrics import (
+    Entity,
+    Metric,
+    DoubleMetric,
+    KeyedDoubleMetric,
+    HistogramMetric,
+    Distribution,
+    DistributionValue,
+)
+from deequ_tpu.data.table import Table, Column, ColumnType
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Try",
+    "Success",
+    "Failure",
+    "Entity",
+    "Metric",
+    "DoubleMetric",
+    "KeyedDoubleMetric",
+    "HistogramMetric",
+    "Distribution",
+    "DistributionValue",
+    "Table",
+    "Column",
+    "ColumnType",
+]

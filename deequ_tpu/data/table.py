@@ -1,0 +1,409 @@
+"""Columnar in-memory table: the engine's DataFrame equivalent.
+
+Design (SURVEY.md §7): numeric/bool columns are dense numpy arrays plus a
+validity bitmask; strings stay host-side (object arrays + dictionary
+encoding) because TPUs can't regex; batches stream to device for fused
+reductions. Replaces the role Spark's DataFrame plays for the reference
+(reference: pom.xml:70-91, L0 in SURVEY layer map).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+
+class ColumnType(enum.Enum):
+    STRING = "StringType"
+    LONG = "LongType"
+    DOUBLE = "DoubleType"
+    BOOLEAN = "BooleanType"
+    TIMESTAMP = "TimestampType"
+    DECIMAL = "DecimalType"
+
+    @property
+    def is_numeric(self) -> bool:
+        return self in (ColumnType.LONG, ColumnType.DOUBLE, ColumnType.DECIMAL)
+
+
+NUMPY_BACKING = {
+    ColumnType.STRING: object,
+    ColumnType.LONG: np.int64,
+    ColumnType.DOUBLE: np.float64,
+    ColumnType.BOOLEAN: np.bool_,
+    ColumnType.TIMESTAMP: "datetime64[us]",
+    ColumnType.DECIMAL: np.float64,
+}
+
+
+@dataclass
+class Column:
+    """One column: dense values + validity mask (True = present).
+
+    Null slots in ``values`` hold an arbitrary fill (0 / "" / epoch); all
+    reductions go through ``valid``.
+    """
+
+    name: str
+    ctype: ColumnType
+    values: np.ndarray
+    valid: np.ndarray
+
+    def __post_init__(self):
+        assert len(self.values) == len(self.valid)
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    @property
+    def null_count(self) -> int:
+        return int(len(self.valid) - self.valid.sum())
+
+    def non_null_values(self) -> np.ndarray:
+        return self.values[self.valid]
+
+    def slice(self, start: int, stop: int) -> "Column":
+        return Column(
+            self.name, self.ctype, self.values[start:stop], self.valid[start:stop]
+        )
+
+    def take(self, indices: np.ndarray) -> "Column":
+        return Column(self.name, self.ctype, self.values[indices], self.valid[indices])
+
+    def numeric_values(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(float64 values, valid) — strings that don't parse as numbers
+        become invalid (null), matching the expr-engine coercion."""
+        if self.ctype == ColumnType.BOOLEAN:
+            return self.values.astype(np.float64), self.valid.copy()
+        if self.ctype == ColumnType.TIMESTAMP:
+            vals = self.values.astype("datetime64[us]").astype(np.int64).astype(np.float64)
+            return vals, self.valid.copy()
+        if self.ctype == ColumnType.STRING:
+            out = np.zeros(len(self.values), dtype=np.float64)
+            valid = self.valid.copy()
+            idx = np.nonzero(self.valid)[0]
+            for i in idx:
+                try:
+                    out[i] = float(self.values[i])
+                except (TypeError, ValueError):
+                    valid[i] = False
+            return out, valid
+        return np.where(self.valid, self.values.astype(np.float64), 0.0), self.valid.copy()
+
+    def as_float(self) -> np.ndarray:
+        """Values as float64; null/unparseable slots = 0.0 (mask separately
+        via ``numeric_values`` when the parse-failure mask matters)."""
+        return self.numeric_values()[0]
+
+    def dict_encode(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Dictionary-encode: (codes int64, uniques). Null rows get code -1.
+
+        The group-by building block: arbitrary keys become dense integer
+        codes the device can bincount/segment-reduce over.
+        """
+        if not self.valid.any():
+            return np.full(len(self.values), -1, dtype=np.int64), np.array([], dtype=object)
+        vals = self.values[self.valid]
+        if self.ctype == ColumnType.STRING:
+            vals = vals.astype(str)
+        uniques, inv = np.unique(vals, return_inverse=True)
+        codes = np.full(len(self.values), -1, dtype=np.int64)
+        codes[self.valid] = inv
+        return codes, uniques
+
+
+def _infer_type(values: Sequence) -> ColumnType:
+    non_null = [v for v in values if v is not None]
+    if not non_null:
+        return ColumnType.STRING
+    if all(isinstance(v, bool) for v in non_null):
+        return ColumnType.BOOLEAN
+    if all(isinstance(v, (int, np.integer)) and not isinstance(v, bool) for v in non_null):
+        return ColumnType.LONG
+    if all(
+        isinstance(v, (int, float, np.integer, np.floating)) and not isinstance(v, bool)
+        for v in non_null
+    ):
+        return ColumnType.DOUBLE
+    return ColumnType.STRING
+
+
+def _column_from_list(name: str, values: Sequence, ctype: Optional[ColumnType]) -> Column:
+    if ctype is None:
+        ctype = _infer_type(values)
+    n = len(values)
+    valid = np.array([v is not None and v == v for v in values], dtype=np.bool_) \
+        if ctype in (ColumnType.DOUBLE, ColumnType.DECIMAL) \
+        else np.array([v is not None for v in values], dtype=np.bool_)
+    backing = NUMPY_BACKING[ctype]
+    if ctype == ColumnType.STRING:
+        arr = np.empty(n, dtype=object)
+        for i, v in enumerate(values):
+            arr[i] = str(v) if v is not None else ""
+    else:
+        fill = {
+            ColumnType.LONG: 0,
+            ColumnType.DOUBLE: 0.0,
+            ColumnType.DECIMAL: 0.0,
+            ColumnType.BOOLEAN: False,
+            ColumnType.TIMESTAMP: np.datetime64(0, "us"),
+        }[ctype]
+        arr = np.array(
+            [v if (v is not None and v == v) else fill for v in values], dtype=backing
+        ) if ctype in (ColumnType.DOUBLE, ColumnType.DECIMAL) else np.array(
+            [v if v is not None else fill for v in values], dtype=backing
+        )
+    return Column(name, ctype, arr, valid)
+
+
+class Table:
+    """Immutable columnar table."""
+
+    def __init__(self, columns: Sequence[Column]):
+        self._columns: Dict[str, Column] = {c.name: c for c in columns}
+        lengths = {len(c) for c in columns}
+        if len(lengths) > 1:
+            raise ValueError(f"ragged columns: {lengths}")
+        self._num_rows = lengths.pop() if lengths else 0
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def from_pydict(
+        data: Dict[str, Sequence], types: Optional[Dict[str, ColumnType]] = None
+    ) -> "Table":
+        types = types or {}
+        return Table(
+            [_column_from_list(k, v, types.get(k)) for k, v in data.items()]
+        )
+
+    @staticmethod
+    def from_numpy(
+        data: Dict[str, np.ndarray],
+        valid: Optional[Dict[str, np.ndarray]] = None,
+        types: Optional[Dict[str, ColumnType]] = None,
+    ) -> "Table":
+        valid = valid or {}
+        types = types or {}
+        cols = []
+        for name, arr in data.items():
+            arr = np.asarray(arr)
+            if name in types:
+                ctype = types[name]
+            elif arr.dtype == np.bool_:
+                ctype = ColumnType.BOOLEAN
+            elif np.issubdtype(arr.dtype, np.integer):
+                ctype = ColumnType.LONG
+            elif np.issubdtype(arr.dtype, np.floating):
+                ctype = ColumnType.DOUBLE
+            elif np.issubdtype(arr.dtype, np.datetime64):
+                ctype = ColumnType.TIMESTAMP
+            else:
+                ctype = ColumnType.STRING
+                arr = arr.astype(object)
+            v = valid.get(name)
+            if v is None:
+                if ctype == ColumnType.DOUBLE:
+                    v = ~np.isnan(arr)
+                    arr = np.where(v, arr, 0.0)
+                elif ctype == ColumnType.STRING:
+                    v = np.array([x is not None for x in arr], dtype=np.bool_)
+                    if not v.all():
+                        arr = arr.copy()
+                        arr[~v] = ""
+                else:
+                    v = np.ones(len(arr), dtype=np.bool_)
+            cols.append(Column(name, ctype, arr, np.asarray(v, dtype=np.bool_)))
+        return Table(cols)
+
+    @staticmethod
+    def from_pandas(df) -> "Table":
+        import pandas as pd  # noqa: F401
+
+        cols = []
+        for name in df.columns:
+            s = df[name]
+            valid = (~s.isna()).to_numpy(dtype=np.bool_)
+            if s.dtype == object or str(s.dtype) in ("string", "str"):
+                arr = np.empty(len(s), dtype=object)
+                raw = s.tolist()
+                all_bool = True
+                for i, v in enumerate(raw):
+                    arr[i] = "" if not valid[i] else str(v)
+                    if valid[i] and not isinstance(v, bool):
+                        all_bool = False
+                if all_bool and valid.any():
+                    barr = np.array(
+                        [bool(v) if valid[i] else False for i, v in enumerate(raw)],
+                        dtype=np.bool_,
+                    )
+                    cols.append(Column(str(name), ColumnType.BOOLEAN, barr, valid))
+                    continue
+                cols.append(Column(str(name), ColumnType.STRING, arr, valid))
+            elif str(s.dtype).startswith("datetime"):
+                arr = s.to_numpy(dtype="datetime64[us]")
+                arr = np.where(valid, arr, np.datetime64(0, "us"))
+                cols.append(Column(str(name), ColumnType.TIMESTAMP, arr, valid))
+            elif s.dtype == np.bool_ or str(s.dtype) == "boolean":
+                arr = s.fillna(False).to_numpy(dtype=np.bool_)
+                cols.append(Column(str(name), ColumnType.BOOLEAN, arr, valid))
+            elif np.issubdtype(s.dtype, np.integer) or str(s.dtype).startswith(
+                ("Int", "UInt")
+            ):
+                arr = s.fillna(0).to_numpy(dtype=np.int64)
+                cols.append(Column(str(name), ColumnType.LONG, arr, valid))
+            else:
+                arr = s.to_numpy(dtype=np.float64)
+                valid = valid & ~np.isnan(np.where(valid, arr, 0.0))
+                arr = np.where(valid, arr, 0.0)
+                cols.append(Column(str(name), ColumnType.DOUBLE, arr, valid))
+        return Table(cols)
+
+    @staticmethod
+    def from_arrow(arrow_table) -> "Table":
+        import pyarrow as pa
+
+        cols = []
+        for name in arrow_table.column_names:
+            arr = arrow_table.column(name).combine_chunks()
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.chunk(0) if arr.num_chunks else pa.array([], arr.type)
+            valid = np.asarray(arr.is_valid())
+            t = arr.type
+            if pa.types.is_boolean(t):
+                vals = np.asarray(arr.fill_null(False))
+                cols.append(Column(name, ColumnType.BOOLEAN, vals, valid))
+            elif pa.types.is_integer(t):
+                vals = np.asarray(arr.fill_null(0)).astype(np.int64)
+                cols.append(Column(name, ColumnType.LONG, vals, valid))
+            elif pa.types.is_floating(t):
+                vals = np.asarray(arr.fill_null(0.0)).astype(np.float64)
+                valid = valid & ~np.isnan(vals)
+                vals = np.where(valid, vals, 0.0)
+                cols.append(Column(name, ColumnType.DOUBLE, vals, valid))
+            elif pa.types.is_decimal(t):
+                vals = np.array(
+                    [float(v) if v is not None else 0.0 for v in arr.to_pylist()],
+                    dtype=np.float64,
+                )
+                cols.append(Column(name, ColumnType.DECIMAL, vals, valid))
+            elif pa.types.is_timestamp(t):
+                vals = np.asarray(arr.cast(pa.timestamp("us")).fill_null(0))
+                cols.append(
+                    Column(name, ColumnType.TIMESTAMP, vals.astype("datetime64[us]"), valid)
+                )
+            else:
+                py = arr.to_pylist()
+                vals = np.empty(len(py), dtype=object)
+                for i, v in enumerate(py):
+                    vals[i] = str(v) if v is not None else ""
+                cols.append(Column(name, ColumnType.STRING, vals, valid))
+        return Table(cols)
+
+    @staticmethod
+    def from_parquet(path: str, columns: Optional[List[str]] = None) -> "Table":
+        import pyarrow.parquet as pq
+
+        return Table.from_arrow(pq.read_table(path, columns=columns))
+
+    # -- schema / access ----------------------------------------------------
+
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    def __len__(self) -> int:
+        return self._num_rows
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self._columns.keys())
+
+    def has_column(self, name: str) -> bool:
+        return name in self._columns
+
+    def column(self, name: str) -> Column:
+        if name not in self._columns:
+            from deequ_tpu.core.exceptions import NoSuchColumnException
+
+            raise NoSuchColumnException(f"Input data does not include column {name}!")
+        return self._columns[name]
+
+    def __getitem__(self, name: str) -> Column:
+        return self.column(name)
+
+    @property
+    def schema(self) -> List[Tuple[str, ColumnType]]:
+        return [(c.name, c.ctype) for c in self._columns.values()]
+
+    # -- transforms ---------------------------------------------------------
+
+    def slice(self, start: int, stop: int) -> "Table":
+        return Table([c.slice(start, stop) for c in self._columns.values()])
+
+    def filter(self, row_mask: np.ndarray) -> "Table":
+        idx = np.nonzero(np.asarray(row_mask, dtype=bool))[0]
+        return Table([c.take(idx) for c in self._columns.values()])
+
+    def select(self, names: Sequence[str]) -> "Table":
+        return Table([self.column(n) for n in names])
+
+    def with_column(self, col: Column) -> "Table":
+        cols = [c for c in self._columns.values() if c.name != col.name]
+        return Table(cols + [col])
+
+    def batches(self, batch_size: int) -> Iterator["Table"]:
+        """Stream fixed-size row slices (the unit shipped to device)."""
+        if self._num_rows == 0:
+            yield self
+            return
+        for start in range(0, self._num_rows, batch_size):
+            yield self.slice(start, min(start + batch_size, self._num_rows))
+
+    def random_split(
+        self, weights: Sequence[float], seed: Optional[int] = None
+    ) -> List["Table"]:
+        """reference: suggestions/ConstraintSuggestionRunner.scala:127-148
+        (df.randomSplit for train/test)."""
+        rng = np.random.default_rng(seed)
+        total = float(sum(weights))
+        u = rng.random(self._num_rows)
+        bounds = np.cumsum([w / total for w in weights])
+        out = []
+        lo = 0.0
+        for hi in bounds:
+            out.append(self.filter((u >= lo) & (u < hi)))
+            lo = hi
+        return out
+
+    def to_pydict(self) -> Dict[str, List]:
+        out: Dict[str, List] = {}
+        for c in self._columns.values():
+            vals: List = []
+            for i in range(len(c)):
+                if not c.valid[i]:
+                    vals.append(None)
+                elif c.ctype == ColumnType.STRING:
+                    vals.append(c.values[i])
+                elif c.ctype == ColumnType.BOOLEAN:
+                    vals.append(bool(c.values[i]))
+                elif c.ctype == ColumnType.LONG:
+                    vals.append(int(c.values[i]))
+                elif c.ctype == ColumnType.TIMESTAMP:
+                    vals.append(c.values[i])
+                else:
+                    vals.append(float(c.values[i]))
+            out[c.name] = vals
+        return out
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.to_pydict())
+
+    def __repr__(self):
+        cols = ", ".join(f"{n}:{t.value}" for n, t in self.schema)
+        return f"Table({self._num_rows} rows; {cols})"

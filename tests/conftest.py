@@ -1,0 +1,25 @@
+"""Test harness: run JAX on a virtual 8-device CPU platform.
+
+The analogue of the reference's SparkContextSpec local-master session
+(reference: src/test/scala/com/amazon/deequ/SparkContextSpec.scala:25-95):
+everything "distributed" is tested without TPU hardware — the host CPU is
+split into 8 XLA devices so mesh/sharding code paths run for real.
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

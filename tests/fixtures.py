@@ -1,0 +1,124 @@
+"""Canonical toy tables shared across tests.
+
+Mirrors the reference's FixtureSupport corpus
+(reference: src/test/scala/com/amazon/deequ/utils/FixtureSupport.scala:24+):
+the same ground-truth shapes (missing values, unique columns, numeric
+columns, conditionally informative pairs) so analyzer expectations carry
+over directly.
+"""
+
+from deequ_tpu.data.table import ColumnType, Table
+
+
+def get_df_missing() -> Table:
+    # 12 rows; att1 has 6 non-null, att2 has 6 non-null
+    return Table.from_pydict(
+        {
+            "item": [str(i) for i in range(1, 13)],
+            "att1": ["a", None, "b", "a", "a", None, "b", "b", "b", None, "b", None],
+            "att2": ["f", "d", "d", None, "f", "f", None, "d", None, "c", None, None],
+        }
+    )
+
+
+def get_df_full() -> Table:
+    return Table.from_pydict(
+        {
+            "item": ["1", "2", "3", "4"],
+            "att1": ["a", "b", "a", "a"],
+            "att2": ["c", "d", "d", "f"],
+        }
+    )
+
+
+def get_df_with_negative_numbers() -> Table:
+    return Table.from_pydict(
+        {
+            "item": ["1", "2", "3", "4"],
+            "att1": ["-1", "-2", "-3", "-4"],
+            "att2": ["-1", "-2", "-3", "-4"],
+        }
+    )
+
+
+def get_df_with_unique_columns() -> Table:
+    return Table.from_pydict(
+        {
+            "unique": ["1", "2", "3", "4", "5", "6"],
+            "nonUnique": ["0", "0", "0", "5", "6", "7"],
+            "nonUniqueWithNulls": [None, "0", "0", None, "5", "6"],
+            "uniqueWithNulls": ["1", None, "3", None, "5", "6"],
+            "onlyUniqueWithOtherNonUnique": ["1", "2", "3", "4", "5", "6"],
+            "halfUniqueCombinedWithNonUnique": ["0", "1", "2", "3", "4", "5"],
+        }
+    )
+
+
+def get_df_with_distinct_values() -> Table:
+    return Table.from_pydict(
+        {
+            "att1": ["a", None, "b", "b", None, "a"],
+            "att2": ["f", "d", "d", None, None, "f"],
+        }
+    )
+
+
+def get_df_with_numeric_values() -> Table:
+    return Table.from_pydict(
+        {
+            "item": ["1", "2", "3", "4", "5", "6"],
+            "att1": [1, 2, 3, 4, 5, 6],
+            "att2": [0, 0, 0, 5, 6, 7],
+        }
+    )
+
+
+def get_df_with_numeric_fractional_values() -> Table:
+    return Table.from_pydict(
+        {
+            "item": ["1", "2", "3", "4", "5", "6"],
+            "att1": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0],
+            "att2": [0.0, 0.0, 0.0, 5.0, 6.0, 7.0],
+        }
+    )
+
+
+def get_df_with_conditionally_uninformative_columns() -> Table:
+    return Table.from_pydict(
+        {"att1": [1, 2, 3], "att2": [0, 0, 0]}
+    )
+
+
+def get_df_with_conditionally_informative_columns() -> Table:
+    return Table.from_pydict(
+        {"att1": [1, 2, 3], "att2": [4, 5, 6]}
+    )
+
+
+def get_full_nulls() -> Table:
+    return Table.from_pydict(
+        {
+            "item": ["1", "2", "3"],
+            "att1": [None, None, None],
+        },
+        types={"att1": ColumnType.STRING},
+    )
+
+
+def get_basic_example_table() -> Table:
+    """The README Item table (reference: examples/BasicExample.scala)."""
+    return Table.from_pydict(
+        {
+            "id": [1, 2, 3, 4, 5],
+            "productName": ["Thingy A", "Thingy B", None, "Thingy D", "Thingy E"],
+            "description": [
+                "awesome thing.",
+                "available at http://thingb.com",
+                None,
+                "checkout https://thingd.ca",
+                "click on https://thinge.ca",
+            ],
+            "priority": ["high", "low", "high", "low", "high"],
+            "numViews": [0, 0, 12, 123, 2],
+        }
+    )

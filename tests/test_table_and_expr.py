@@ -1,0 +1,162 @@
+import numpy as np
+import pytest
+
+from deequ_tpu.core.maybe import Failure, Success, Try
+from deequ_tpu.data.expr import ExpressionParseError, Predicate, eval_predicate
+from deequ_tpu.data.table import ColumnType, Table
+
+
+class TestTry:
+    def test_success(self):
+        t = Try.of(lambda: 42)
+        assert t.is_success and t.get() == 42
+        assert t.map(lambda x: x + 1).get() == 43
+
+    def test_failure(self):
+        t = Try.of(lambda: 1 / 0)
+        assert t.is_failure
+        assert t.get_or_else(7) == 7
+        assert isinstance(t, Failure)
+
+    def test_failure_equality_by_class_and_message(self):
+        a = Try.of(lambda: (_ for _ in ()).throw(ValueError("x")))
+        b = Failure(ValueError("x"))
+        assert a == b
+
+
+class TestTable:
+    def test_infer_types(self):
+        t = Table.from_pydict(
+            {"s": ["a", None], "i": [1, 2], "f": [1.0, None], "b": [True, False]}
+        )
+        assert dict(t.schema) == {
+            "s": ColumnType.STRING,
+            "i": ColumnType.LONG,
+            "f": ColumnType.DOUBLE,
+            "b": ColumnType.BOOLEAN,
+        }
+        assert t.num_rows == 2
+        assert t["s"].null_count == 1
+        assert t["f"].null_count == 1
+
+    def test_batches(self):
+        t = Table.from_pydict({"x": list(range(10))})
+        sizes = [b.num_rows for b in t.batches(4)]
+        assert sizes == [4, 4, 2]
+
+    def test_dict_encode(self):
+        t = Table.from_pydict({"x": ["b", "a", None, "b"]})
+        codes, uniques = t["x"].dict_encode()
+        assert list(uniques) == ["a", "b"]
+        assert list(codes) == [1, 0, -1, 1]
+
+    def test_roundtrip_pandas(self):
+        t = Table.from_pydict({"x": [1, 2, None], "y": ["a", None, "c"]})
+        t2 = Table.from_pandas(t.to_pandas())
+        assert t2.num_rows == 3
+        assert t2["y"].null_count == 1
+
+    def test_arrow_roundtrip(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        at = pa.table({"a": [1, 2, None], "b": [1.5, None, 2.5], "c": ["x", "y", None]})
+        p = str(tmp_path / "t.parquet")
+        pq.write_table(at, p)
+        t = Table.from_parquet(p)
+        assert t.num_rows == 3
+        assert t["a"].null_count == 1
+        assert t["b"].null_count == 1
+        assert t["c"].null_count == 1
+        assert t["a"].ctype == ColumnType.LONG
+
+    def test_missing_column_raises(self):
+        from deequ_tpu.core.exceptions import NoSuchColumnException
+
+        t = Table.from_pydict({"x": [1]})
+        with pytest.raises(NoSuchColumnException):
+            t.column("nope")
+
+
+class TestPredicate:
+    def table(self):
+        return Table.from_pydict(
+            {
+                "att1": [1, 2, 3, None, 5, 6],
+                "att2": [0, 0, 0, 5, 6, 7],
+                "name": ["a", "b", None, "a", "c", "ab"],
+            }
+        )
+
+    def test_comparison(self):
+        m = eval_predicate("att1 > 3", self.table())
+        assert list(m) == [False, False, False, False, True, True]
+
+    def test_null_propagates_to_false(self):
+        m = eval_predicate("att1 >= 1", self.table())
+        assert list(m) == [True, True, True, False, True, True]
+
+    def test_and_or(self):
+        m = eval_predicate("att1 > 1 AND att2 = 0", self.table())
+        assert list(m) == [False, True, True, False, False, False]
+        m = eval_predicate("att1 > 5 OR att2 > 5", self.table())
+        assert list(m) == [False, False, False, False, True, True]
+
+    def test_is_null(self):
+        m = eval_predicate("att1 IS NULL", self.table())
+        assert list(m) == [False, False, False, True, False, False]
+        m = eval_predicate("name IS NOT NULL", self.table())
+        assert list(m) == [True, True, False, True, True, True]
+
+    def test_in_list(self):
+        m = eval_predicate("name IN ('a', 'c')", self.table())
+        assert list(m) == [True, False, False, True, True, False]
+
+    def test_null_or_in(self):
+        # the isContainedIn shape: `col` IS NULL OR `col` IN (...)
+        m = eval_predicate("`name` IS NULL OR `name` IN ('a','b')", self.table())
+        assert list(m) == [True, True, True, True, False, False]
+
+    def test_coalesce(self):
+        # the isNonNegative shape: COALESCE(col, 0.0) >= 0
+        m = eval_predicate("COALESCE(att1, 0.0) >= 0", self.table())
+        assert list(m) == [True] * 6
+
+    def test_arithmetic(self):
+        m = eval_predicate("att1 * 2 + 1 >= att2 + 6", self.table())
+        # att1*2+1: 3,5,7,null,11,13 ; att2+6: 6,6,6,11,12,13
+        assert list(m) == [False, False, True, False, False, True]
+
+    def test_between(self):
+        m = eval_predicate("att2 BETWEEN 5 AND 6", self.table())
+        assert list(m) == [False, False, False, True, True, False]
+
+    def test_like_rlike(self):
+        m = eval_predicate("name LIKE 'a%'", self.table())
+        assert list(m) == [True, False, False, True, False, True]
+        m = eval_predicate("name RLIKE '^a$'", self.table())
+        assert list(m) == [True, False, False, True, False, False]
+
+    def test_string_numeric_coercion(self):
+        t = Table.from_pydict({"s": ["1", "2", "x", None]})
+        m = eval_predicate("s >= 2", t)
+        assert list(m) == [False, True, False, False]
+
+    def test_division_by_zero_is_null(self):
+        m = eval_predicate("att1 / att2 > 0", self.table())
+        # att2 = 0 on rows 0-2 -> NULL -> False; row 3 att1 NULL -> False
+        assert list(m) == [False, False, False, False, True, True]
+
+    def test_parse_error(self):
+        with pytest.raises(ExpressionParseError):
+            Predicate("att1 >>> 3")
+        with pytest.raises(ExpressionParseError):
+            Predicate("someInvalidExpression !!")
+
+    def test_referenced_columns(self):
+        p = Predicate("att1 > 3 AND COALESCE(att2, 0) = 0 OR name IN ('a')")
+        assert set(p.referenced_columns()) == {"att1", "att2", "name"}
+
+    def test_not(self):
+        m = eval_predicate("NOT att2 = 0", self.table())
+        assert list(m) == [False, False, False, True, True, True]
