@@ -1,0 +1,21 @@
+"""Grouping (frequency-based) analyzers — marker + shared state.
+
+reference: analyzers/GroupingAnalyzers.scala, analyzers/Analyzer.scala:263-272.
+Concrete frequency analyzers land with the grouping milestone; the marker
+class exists so the runner can partition analyzer sets.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from deequ_tpu.analyzers.base import Analyzer
+
+
+class GroupingAnalyzer(Analyzer):
+    """Marker: analyzers that need a group-by over some column set.
+    Analyzers with the same (sorted) grouping columns share one frequency
+    computation (reference: AnalysisRunner.scala:164-180)."""
+
+    def grouping_columns(self) -> List[str]:
+        raise NotImplementedError

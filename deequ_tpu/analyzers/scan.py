@@ -1,0 +1,811 @@
+"""Scan-shareable analyzers: single-pass masked reductions.
+
+Each analyzer's heavy work is a per-batch reduction expressed once, generic
+over the array namespace (jnp on device, numpy float64 on the host fold) —
+the same code path serves the fused XLA pass, the cross-device collective
+merge, and the driver-side cross-batch fold. This replaces the reference's
+Catalyst aggregate kernels (reference: analyzers/catalyst/, SURVEY.md §2.6)
+and its per-analyzer `aggregationFunctions()` offsets
+(reference: analyzers/Analyzer.scala:159-216).
+
+Aggregate pytrees are dicts of scalars; all masks enter reductions as
+multiplicative 0/1 factors so padded rows and filtered rows contribute
+exactly nothing.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from deequ_tpu.analyzers.base import (
+    InputSpec,
+    Preconditions,
+    ScanShareableAnalyzer,
+    col_valid_spec,
+    col_values_spec,
+    entity_from,
+    render_where,
+    where_key,
+    where_spec,
+)
+from deequ_tpu.analyzers.states import (
+    CorrelationState,
+    DataTypeHistogram,
+    MaxState,
+    MeanState,
+    MinState,
+    NumMatches,
+    NumMatchesAndCount,
+    State,
+    StandardDeviationState,
+    SumState,
+)
+from deequ_tpu.core.maybe import Success
+from deequ_tpu.core.metrics import (
+    Distribution,
+    DistributionValue,
+    DoubleMetric,
+    Entity,
+    HistogramMetric,
+    Metric,
+)
+from deequ_tpu.data.table import Column, ColumnType, Table
+
+
+def _f(xp, x):
+    """Cast mask/ints to the float dtype reductions run in."""
+    return xp.asarray(x).astype(xp.result_type(0.0))
+
+
+# ---------------------------------------------------------------------------
+# Size
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Size(ScanShareableAnalyzer):
+    """# rows, optionally filtered (reference: analyzers/Size.scala:36)."""
+
+    where: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return "Size"
+
+    @property
+    def instance(self) -> str:
+        return "*"
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.DATASET
+
+    def input_specs(self) -> List[InputSpec]:
+        return [where_spec(self.where)]
+
+    def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        w = _f(xp, inputs[where_key(self.where)])
+        return {"n": xp.sum(w)}
+
+    def merge_agg(self, a: Any, b: Any, xp) -> Any:
+        return {"n": a["n"] + b["n"]}
+
+    def state_from_aggregates(self, agg: Any) -> Optional[State]:
+        return NumMatches(int(agg["n"]))
+
+    def compute_metric_from(self, state: Optional[State]) -> Metric:
+        if state is None:
+            return self.empty_state_failure()
+        return DoubleMetric(
+            self.entity, self.name, self.instance, Success(state.metric_value())
+        )
+
+    def __repr__(self) -> str:
+        return f"Size({render_where(self.where)})"
+
+
+# ---------------------------------------------------------------------------
+# Ratio analyzers: Completeness / Compliance / PatternMatch
+# ---------------------------------------------------------------------------
+
+
+class _RatioAnalyzer(ScanShareableAnalyzer):
+    """matches/count with a guard leaf for the empty-state rule.
+
+    The guard mirrors SQL `sum` nullability in the reference's aggregation
+    expressions: the state is empty (None -> EmptyStateException) exactly
+    when every row's criterion was NULL. For Completeness the criterion
+    (`isNotNull(...)`) is never NULL, so the guard is "any row scanned"; for
+    Compliance/PatternMatch non-matching `where` rows and NULL inputs make
+    the criterion NULL, so the guard is "any row with where ∧ non-null
+    input" (reference: analyzers/Completeness.scala:36-41,
+    Compliance.scala:50, PatternMatch.scala:42-50)."""
+
+    def _match_mask_key(self) -> str:
+        raise NotImplementedError
+
+    def _extra_specs(self) -> List[InputSpec]:
+        raise NotImplementedError
+
+    def _guard(self, inputs: Dict[str, Any], xp):
+        """Mask of rows whose criterion is non-NULL."""
+        raise NotImplementedError
+
+    def input_specs(self) -> List[InputSpec]:
+        return self._extra_specs() + [where_spec(self.where), where_spec(None)]
+
+    def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        w = _f(xp, inputs[where_key(self.where)])
+        m = _f(xp, inputs[self._match_mask_key()])
+        return {
+            "matches": xp.sum(m * w),
+            "count": xp.sum(w),
+            "guard": xp.sum(_f(xp, self._guard(inputs, xp))),
+        }
+
+    def merge_agg(self, a: Any, b: Any, xp) -> Any:
+        return {k: a[k] + b[k] for k in ("matches", "count", "guard")}
+
+    def state_from_aggregates(self, agg: Any) -> Optional[State]:
+        if int(agg["guard"]) == 0:
+            return None
+        return NumMatchesAndCount(int(agg["matches"]), int(agg["count"]))
+
+    def compute_metric_from(self, state: Optional[State]) -> Metric:
+        if state is None:
+            return self.empty_state_failure()
+        return DoubleMetric(
+            self.entity, self.name, self.instance, Success(state.metric_value())
+        )
+
+
+@dataclass(frozen=True)
+class Completeness(_RatioAnalyzer):
+    """Fraction non-NULL (reference: analyzers/Completeness.scala:26)."""
+
+    column: str
+    where: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return "Completeness"
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    def preconditions(self) -> List[Callable[[Table], None]]:
+        return [Preconditions.has_column(self.column)]
+
+    def _match_mask_key(self) -> str:
+        return f"valid:{self.column}"
+
+    def _extra_specs(self) -> List[InputSpec]:
+        return [col_valid_spec(self.column)]
+
+    def _guard(self, inputs: Dict[str, Any], xp):
+        # isNotNull(...) is never NULL: empty only when nothing was scanned
+        return inputs[where_key(None)]
+
+    def __repr__(self) -> str:
+        return f"Completeness({self.column},{render_where(self.where)})"
+
+
+def _pred_spec(predicate: str) -> InputSpec:
+    from deequ_tpu.data.expr import Predicate
+
+    pred = Predicate(predicate)
+    return InputSpec(key=f"pred:{predicate}", build=lambda t: pred.eval_mask(t))
+
+
+def _pred_nonnull_spec(predicate: str) -> InputSpec:
+    from deequ_tpu.data.expr import Predicate
+
+    pred = Predicate(predicate)
+
+    def build(t: Table) -> np.ndarray:
+        _, null, _ = pred.eval(t)
+        return ~null
+
+    return InputSpec(key=f"prednn:{predicate}", build=build)
+
+
+@dataclass(frozen=True)
+class Compliance(_RatioAnalyzer):
+    """Fraction of rows satisfying an arbitrary SQL predicate
+    (reference: analyzers/Compliance.scala:37)."""
+
+    instance_name: str
+    predicate: str
+    where: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return "Compliance"
+
+    @property
+    def instance(self) -> str:
+        return self.instance_name
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.COLUMN
+
+    def _match_mask_key(self) -> str:
+        return f"pred:{self.predicate}"
+
+    def _extra_specs(self) -> List[InputSpec]:
+        return [_pred_spec(self.predicate), _pred_nonnull_spec(self.predicate)]
+
+    def _guard(self, inputs: Dict[str, Any], xp):
+        # criterion NULL on where-misses and NULL predicate results
+        w = _f(xp, inputs[where_key(self.where)])
+        return w * _f(xp, inputs[f"prednn:{self.predicate}"])
+
+    def __repr__(self) -> str:
+        return f"Compliance({self.instance_name},{self.predicate},{render_where(self.where)})"
+
+
+class Patterns:
+    """Built-in patterns (reference: analyzers/PatternMatch.scala:57-70;
+    the regexes are cited third-party public constants)."""
+
+    # http://emailregex.com
+    EMAIL = (
+        r"""(?:[a-z0-9!#$%&'*+/=?^_`{|}~-]+(?:\.[a-z0-9!#$%&'*+/=?^_`{|}~-]+)*"""
+        r"""|"(?:[\x01-\x08\x0b\x0c\x0e-\x1f\x21\x23-\x5b\x5d-\x7f]|\\[\x01-\x09\x0b\x0c\x0e-\x7f])*")"""
+        r"""@(?:(?:[a-z0-9](?:[a-z0-9-]*[a-z0-9])?\.)+[a-z0-9](?:[a-z0-9-]*[a-z0-9])?"""
+        r"""|\[(?:(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?)\.){3}"""
+        r"""(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?|[a-z0-9-]*[a-z0-9]:"""
+        r"""(?:[\x01-\x08\x0b\x0c\x0e-\x1f\x21-\x5a\x53-\x7f]|\\[\x01-\x09\x0b\x0c\x0e-\x7f])+)\])"""
+    )
+
+    # https://mathiasbynens.be/demo/url-regex (@stephenhay)
+    URL = r"""(https?|ftp)://[^\s/$.?#].[^\s]*"""
+
+    SOCIAL_SECURITY_NUMBER_US = (
+        r"""((?!219-09-9999|078-05-1120)(?!666|000|9\d{2})\d{3}-(?!00)\d{2}-(?!0{4})\d{4})"""
+        r"""|((?!219 09 9999|078 05 1120)(?!666|000|9\d{2})\d{3} (?!00)\d{2} (?!0{4})\d{4})"""
+        r"""|((?!219099999|078051120)(?!666|000|9\d{2})\d{3}(?!00)\d{2}(?!0{4})\d{4})"""
+    )
+
+    # http://www.richardsramblings.com/regex/credit-card-numbers/
+    CREDITCARD = (
+        r"""\b(?:3[47]\d{2}([\ \-]?)\d{6}\1\d|(?:(?:4\d|5[1-5]|65)\d{2}|6011)"""
+        r"""([\ \-]?)\d{4}\2\d{4}\2)\d{4}\b"""
+    )
+
+
+def _match_spec(column: str, pattern: str) -> InputSpec:
+    rx = re.compile(pattern)
+
+    def build(t: Table) -> np.ndarray:
+        col = t.column(column)
+        out = np.zeros(len(col), dtype=np.bool_)
+        idx = np.nonzero(col.valid)[0]
+        for i in idx:
+            m = rx.search(str(col.values[i]))
+            # Spark: regexp_extract(col, regex, 0) != "" — empty match is a miss
+            out[i] = m is not None and m.group(0) != ""
+        return out
+
+    return InputSpec(key=f"match:{column}:{pattern}", build=build)
+
+
+@dataclass(frozen=True)
+class PatternMatch(_RatioAnalyzer):
+    """Fraction of values matching a regex
+    (reference: analyzers/PatternMatch.scala:37)."""
+
+    column: str
+    pattern: str
+    where: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return "PatternMatch"
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    def preconditions(self) -> List[Callable[[Table], None]]:
+        return [
+            Preconditions.has_column(self.column),
+            Preconditions.is_string(self.column),
+        ]
+
+    def _match_mask_key(self) -> str:
+        return f"match:{self.column}:{self.pattern}"
+
+    def _extra_specs(self) -> List[InputSpec]:
+        return [_match_spec(self.column, self.pattern), col_valid_spec(self.column)]
+
+    def _guard(self, inputs: Dict[str, Any], xp):
+        # regexp_extract(NULL) is NULL: criterion non-NULL iff where ∧ value present
+        w = _f(xp, inputs[where_key(self.where)])
+        return w * _f(xp, inputs[f"valid:{self.column}"])
+
+    def __repr__(self) -> str:
+        return f"PatternMatch({self.column},{self.pattern},{render_where(self.where)})"
+
+
+# ---------------------------------------------------------------------------
+# Numeric moments: Mean / Min / Max / Sum / StdDev / Correlation
+# ---------------------------------------------------------------------------
+
+
+class _NumericScanAnalyzer(ScanShareableAnalyzer):
+    def preconditions(self) -> List[Callable[[Table], None]]:
+        return [
+            Preconditions.has_column(self.column),
+            Preconditions.is_numeric(self.column),
+        ]
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    def input_specs(self) -> List[InputSpec]:
+        return [
+            col_values_spec(self.column),
+            col_valid_spec(self.column),
+            where_spec(self.where),
+        ]
+
+    def _masked(self, inputs: Dict[str, Any], xp):
+        x = xp.asarray(inputs[f"num:{self.column}"])
+        m = _f(xp, inputs[f"valid:{self.column}"]) * _f(
+            xp, inputs[where_key(self.where)]
+        )
+        return x, m
+
+    def compute_metric_from(self, state: Optional[State]) -> Metric:
+        if state is None:
+            return self.empty_state_failure()
+        return DoubleMetric(
+            self.entity, self.name, self.instance, Success(state.metric_value())
+        )
+
+
+@dataclass(frozen=True)
+class Mean(_NumericScanAnalyzer):
+    """reference: analyzers/Mean.scala:36."""
+
+    column: str
+    where: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return "Mean"
+
+    def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        x, m = self._masked(inputs, xp)
+        return {"total": xp.sum(x * m), "count": xp.sum(m)}
+
+    def merge_agg(self, a: Any, b: Any, xp) -> Any:
+        return {"total": a["total"] + b["total"], "count": a["count"] + b["count"]}
+
+    def state_from_aggregates(self, agg: Any) -> Optional[State]:
+        if int(agg["count"]) == 0:
+            return None
+        return MeanState(float(agg["total"]), int(agg["count"]))
+
+    def __repr__(self) -> str:
+        return f"Mean({self.column},{render_where(self.where)})"
+
+
+@dataclass(frozen=True)
+class Sum(_NumericScanAnalyzer):
+    """reference: analyzers/Sum.scala:36."""
+
+    column: str
+    where: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return "Sum"
+
+    def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        x, m = self._masked(inputs, xp)
+        return {"sum": xp.sum(x * m), "count": xp.sum(m)}
+
+    def merge_agg(self, a: Any, b: Any, xp) -> Any:
+        return {"sum": a["sum"] + b["sum"], "count": a["count"] + b["count"]}
+
+    def state_from_aggregates(self, agg: Any) -> Optional[State]:
+        if int(agg["count"]) == 0:
+            return None
+        return SumState(float(agg["sum"]))
+
+    def __repr__(self) -> str:
+        return f"Sum({self.column},{render_where(self.where)})"
+
+
+@dataclass(frozen=True)
+class Minimum(_NumericScanAnalyzer):
+    """reference: analyzers/Minimum.scala:36."""
+
+    column: str
+    where: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return "Minimum"
+
+    def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        x, m = self._masked(inputs, xp)
+        masked = xp.where(m > 0, x, xp.inf)
+        return {"min": xp.min(masked), "count": xp.sum(m)}
+
+    def merge_agg(self, a: Any, b: Any, xp) -> Any:
+        return {"min": xp.minimum(a["min"], b["min"]), "count": a["count"] + b["count"]}
+
+    def state_from_aggregates(self, agg: Any) -> Optional[State]:
+        if int(agg["count"]) == 0:
+            return None
+        return MinState(float(agg["min"]))
+
+    def __repr__(self) -> str:
+        return f"Minimum({self.column},{render_where(self.where)})"
+
+
+@dataclass(frozen=True)
+class Maximum(_NumericScanAnalyzer):
+    """reference: analyzers/Maximum.scala:36."""
+
+    column: str
+    where: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return "Maximum"
+
+    def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        x, m = self._masked(inputs, xp)
+        masked = xp.where(m > 0, x, -xp.inf)
+        return {"max": xp.max(masked), "count": xp.sum(m)}
+
+    def merge_agg(self, a: Any, b: Any, xp) -> Any:
+        return {"max": xp.maximum(a["max"], b["max"]), "count": a["count"] + b["count"]}
+
+    def state_from_aggregates(self, agg: Any) -> Optional[State]:
+        if int(agg["count"]) == 0:
+            return None
+        return MaxState(float(agg["max"]))
+
+    def __repr__(self) -> str:
+        return f"Maximum({self.column},{render_where(self.where)})"
+
+
+@dataclass(frozen=True)
+class StandardDeviation(_NumericScanAnalyzer):
+    """Population stddev via per-batch centered moments + Chan merge
+    (reference: analyzers/StandardDeviation.scala:47, kernel
+    catalyst/StatefulStdDevPop.scala:24). The batch pass computes the mean
+    first, then sums centered squares — two reads of HBM, full accuracy in
+    f32."""
+
+    column: str
+    where: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return "StandardDeviation"
+
+    def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        x, m = self._masked(inputs, xp)
+        n = xp.sum(m)
+        safe_n = xp.maximum(n, 1.0)
+        avg = xp.sum(x * m) / safe_n
+        m2 = xp.sum(((x - avg) * m) ** 2)
+        return {"n": n, "avg": xp.where(n > 0, avg, 0.0), "m2": m2}
+
+    def merge_agg(self, a: Any, b: Any, xp) -> Any:
+        n = a["n"] + b["n"]
+        safe_n = xp.maximum(n, 1.0)
+        delta = b["avg"] - a["avg"]
+        avg = (a["n"] * a["avg"] + b["n"] * b["avg"]) / safe_n
+        m2 = a["m2"] + b["m2"] + delta * delta * a["n"] * b["n"] / safe_n
+        return {"n": n, "avg": xp.where(n > 0, avg, 0.0), "m2": m2}
+
+    def state_from_aggregates(self, agg: Any) -> Optional[State]:
+        if float(agg["n"]) == 0:
+            return None
+        return StandardDeviationState(float(agg["n"]), float(agg["avg"]), float(agg["m2"]))
+
+    def __repr__(self) -> str:
+        return f"StandardDeviation({self.column},{render_where(self.where)})"
+
+
+@dataclass(frozen=True)
+class Correlation(ScanShareableAnalyzer):
+    """Pearson r via per-batch centered co-moments + pairwise merge
+    (reference: analyzers/Correlation.scala:65, kernel
+    catalyst/StatefulCorrelation.scala:24). Rows enter only when BOTH
+    columns are non-null."""
+
+    first_column: str
+    second_column: str
+    where: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return "Correlation"
+
+    @property
+    def instance(self) -> str:
+        return f"{self.first_column},{self.second_column}"
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.MULTICOLUMN
+
+    def preconditions(self) -> List[Callable[[Table], None]]:
+        return [
+            Preconditions.has_column(self.first_column),
+            Preconditions.is_numeric(self.first_column),
+            Preconditions.has_column(self.second_column),
+            Preconditions.is_numeric(self.second_column),
+        ]
+
+    def input_specs(self) -> List[InputSpec]:
+        return [
+            col_values_spec(self.first_column),
+            col_valid_spec(self.first_column),
+            col_values_spec(self.second_column),
+            col_valid_spec(self.second_column),
+            where_spec(self.where),
+        ]
+
+    def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        x = xp.asarray(inputs[f"num:{self.first_column}"])
+        y = xp.asarray(inputs[f"num:{self.second_column}"])
+        m = (
+            _f(xp, inputs[f"valid:{self.first_column}"])
+            * _f(xp, inputs[f"valid:{self.second_column}"])
+            * _f(xp, inputs[where_key(self.where)])
+        )
+        n = xp.sum(m)
+        safe_n = xp.maximum(n, 1.0)
+        x_avg = xp.sum(x * m) / safe_n
+        y_avg = xp.sum(y * m) / safe_n
+        xc = (x - x_avg) * m
+        yc = (y - y_avg) * m
+        return {
+            "n": n,
+            "x_avg": xp.where(n > 0, x_avg, 0.0),
+            "y_avg": xp.where(n > 0, y_avg, 0.0),
+            "ck": xp.sum(xc * yc),
+            "x_mk": xp.sum(xc * xc),
+            "y_mk": xp.sum(yc * yc),
+        }
+
+    def merge_agg(self, a: Any, b: Any, xp) -> Any:
+        n = a["n"] + b["n"]
+        safe_n = xp.maximum(n, 1.0)
+        dx = b["x_avg"] - a["x_avg"]
+        dy = b["y_avg"] - a["y_avg"]
+        frac = b["n"] / safe_n
+        cross = a["n"] * b["n"] / safe_n
+        return {
+            "n": n,
+            "x_avg": a["x_avg"] + dx * frac,
+            "y_avg": a["y_avg"] + dy * frac,
+            "ck": a["ck"] + b["ck"] + dx * dy * cross,
+            "x_mk": a["x_mk"] + b["x_mk"] + dx * dx * cross,
+            "y_mk": a["y_mk"] + b["y_mk"] + dy * dy * cross,
+        }
+
+    def state_from_aggregates(self, agg: Any) -> Optional[State]:
+        if float(agg["n"]) == 0:
+            return None
+        return CorrelationState(
+            float(agg["n"]),
+            float(agg["x_avg"]),
+            float(agg["y_avg"]),
+            float(agg["ck"]),
+            float(agg["x_mk"]),
+            float(agg["y_mk"]),
+        )
+
+    def compute_metric_from(self, state: Optional[State]) -> Metric:
+        if state is None:
+            return self.empty_state_failure()
+        return DoubleMetric(
+            self.entity, self.name, self.instance, Success(state.metric_value())
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"Correlation({self.first_column},{self.second_column},"
+            f"{render_where(self.where)})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# DataType
+# ---------------------------------------------------------------------------
+
+
+class DataTypeInstances:
+    UNKNOWN = "Unknown"
+    FRACTIONAL = "Fractional"
+    INTEGRAL = "Integral"
+    BOOLEAN = "Boolean"
+    STRING = "String"
+
+
+# value-classification regexes (reference: catalyst/StatefulDataType.scala:36-38)
+_FRACTIONAL_RE = re.compile(r"^(-|\+)? ?\d*\.\d*$")
+_INTEGRAL_RE = re.compile(r"^(-|\+)? ?\d*$")
+_BOOLEAN_RE = re.compile(r"^(true|false)$")
+
+# class codes used on device: order matches DataTypeHistogram fields
+_CODE_NULL, _CODE_FRACTIONAL, _CODE_INTEGRAL, _CODE_BOOLEAN, _CODE_STRING = range(5)
+
+
+def _classify_strings(values: np.ndarray, valid: np.ndarray) -> np.ndarray:
+    codes = np.zeros(len(values), dtype=np.int32)
+    idx = np.nonzero(valid)[0]
+    for i in idx:
+        v = str(values[i])
+        if _FRACTIONAL_RE.match(v):
+            codes[i] = _CODE_FRACTIONAL
+        elif _INTEGRAL_RE.match(v):
+            codes[i] = _CODE_INTEGRAL
+        elif _BOOLEAN_RE.match(v):
+            codes[i] = _CODE_BOOLEAN
+        else:
+            codes[i] = _CODE_STRING
+    return codes
+
+
+def _dtclass_spec(column: str) -> InputSpec:
+    def build(t: Table) -> np.ndarray:
+        col = t.column(column)
+        if col.ctype == ColumnType.STRING:
+            return _classify_strings(col.values, col.valid)
+        # typed columns classify statically from the stringified form
+        static = {
+            ColumnType.LONG: _CODE_INTEGRAL,
+            ColumnType.DOUBLE: _CODE_FRACTIONAL,
+            ColumnType.DECIMAL: _CODE_FRACTIONAL,
+            ColumnType.BOOLEAN: _CODE_BOOLEAN,
+            ColumnType.TIMESTAMP: _CODE_STRING,
+        }[col.ctype]
+        return np.where(col.valid, np.int32(static), np.int32(_CODE_NULL))
+
+    return InputSpec(key=f"dtclass:{column}", build=build)
+
+
+@dataclass(frozen=True)
+class DataType(ScanShareableAnalyzer):
+    """Histogram over inferred value types + majority-type inference
+    (reference: analyzers/DataType.scala:32-183). Rows excluded by `where`
+    become NULL before classification (exactly like conditionalSelection
+    feeding the reference UDAF), so they count as Unknown."""
+
+    column: str
+    where: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return "Histogram"
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    def preconditions(self) -> List[Callable[[Table], None]]:
+        return [Preconditions.has_column(self.column)]
+
+    def input_specs(self) -> List[InputSpec]:
+        return [_dtclass_spec(self.column), where_spec(self.where), where_spec(None)]
+
+    def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        codes = xp.asarray(inputs[f"dtclass:{self.column}"])
+        w = inputs[where_key(self.where)]
+        rows = _f(xp, inputs[where_key(None)])
+        # where-filtered rows -> NULL class; padded rows excluded via `rows`
+        codes = xp.where(xp.asarray(w), codes, _CODE_NULL)
+        counts = {}
+        for code, label in enumerate(
+            ("null", "fractional", "integral", "boolean", "string")
+        ):
+            counts[label] = xp.sum(_f(xp, codes == code) * rows)
+        return counts
+
+    def merge_agg(self, a: Any, b: Any, xp) -> Any:
+        return {k: a[k] + b[k] for k in a}
+
+    def state_from_aggregates(self, agg: Any) -> Optional[State]:
+        return DataTypeHistogram(
+            int(agg["null"]),
+            int(agg["fractional"]),
+            int(agg["integral"]),
+            int(agg["boolean"]),
+            int(agg["string"]),
+        )
+
+    def compute_metric_from(self, state: Optional[State]) -> Metric:
+        if state is None:
+            return self.to_failure_metric_histogram()
+        return HistogramMetric(
+            Entity.COLUMN,
+            self.name,
+            self.column,
+            Success(to_distribution(state)),
+        )
+
+    def to_failure_metric(self, exception: BaseException) -> Metric:
+        from deequ_tpu.core.exceptions import wrap_if_necessary
+        from deequ_tpu.core.maybe import Failure
+
+        return HistogramMetric(
+            Entity.COLUMN, self.name, self.column, Failure(wrap_if_necessary(exception))
+        )
+
+    def to_failure_metric_histogram(self) -> Metric:
+        from deequ_tpu.core.exceptions import EmptyStateException
+
+        return self.to_failure_metric(
+            EmptyStateException(
+                f"Empty state for analyzer {self!r}, all input values were NULL."
+            )
+        )
+
+    def __repr__(self) -> str:
+        return f"DataType({self.column},{render_where(self.where)})"
+
+
+def to_distribution(hist: DataTypeHistogram) -> Distribution:
+    """reference: analyzers/DataType.scala:100-115."""
+    total = hist.total
+    ratio = (lambda c: c / total) if total > 0 else (lambda c: float("nan"))
+    return Distribution(
+        {
+            DataTypeInstances.UNKNOWN: DistributionValue(hist.num_null, ratio(hist.num_null)),
+            DataTypeInstances.FRACTIONAL: DistributionValue(
+                hist.num_fractional, ratio(hist.num_fractional)
+            ),
+            DataTypeInstances.INTEGRAL: DistributionValue(
+                hist.num_integral, ratio(hist.num_integral)
+            ),
+            DataTypeInstances.BOOLEAN: DistributionValue(
+                hist.num_boolean, ratio(hist.num_boolean)
+            ),
+            DataTypeInstances.STRING: DistributionValue(
+                hist.num_string, ratio(hist.num_string)
+            ),
+        },
+        number_of_bins=5,
+    )
+
+
+def determine_type(dist: Distribution) -> str:
+    """Majority-type decision tree (reference: analyzers/DataType.scala:116-146)."""
+
+    def ratio_of(key: str) -> float:
+        v = dist.values.get(key)
+        return v.ratio if v is not None else 0.0
+
+    if ratio_of(DataTypeInstances.UNKNOWN) == 1.0:
+        return DataTypeInstances.UNKNOWN
+    if ratio_of(DataTypeInstances.STRING) > 0.0 or (
+        ratio_of(DataTypeInstances.BOOLEAN) > 0.0
+        and (
+            ratio_of(DataTypeInstances.INTEGRAL) > 0.0
+            or ratio_of(DataTypeInstances.FRACTIONAL) > 0.0
+        )
+    ):
+        return DataTypeInstances.STRING
+    if ratio_of(DataTypeInstances.BOOLEAN) > 0.0:
+        return DataTypeInstances.BOOLEAN
+    if ratio_of(DataTypeInstances.FRACTIONAL) > 0.0:
+        return DataTypeInstances.FRACTIONAL
+    return DataTypeInstances.INTEGRAL

@@ -1,0 +1,47 @@
+"""State checkpoint layer: load/persist analyzer states.
+
+reference: analyzers/StateProvider.scala:36-69 (traits + in-memory
+provider). The filesystem provider with binary per-analyzer formats is in
+deequ_tpu/repository (added with the persistence milestone).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import TYPE_CHECKING, Dict, Optional
+
+from deequ_tpu.analyzers.states import State
+
+if TYPE_CHECKING:
+    from deequ_tpu.analyzers.base import Analyzer
+
+
+class StateLoader:
+    def load(self, analyzer: "Analyzer") -> Optional[State]:
+        raise NotImplementedError
+
+
+class StatePersister:
+    def persist(self, analyzer: "Analyzer", state: State) -> None:
+        raise NotImplementedError
+
+
+class InMemoryStateProvider(StateLoader, StatePersister):
+    """Keyed by analyzer identity (reference: StateProvider.scala:46-69)."""
+
+    def __init__(self) -> None:
+        self._states: Dict["Analyzer", State] = {}
+        self._lock = threading.Lock()
+
+    def load(self, analyzer: "Analyzer") -> Optional[State]:
+        with self._lock:
+            return self._states.get(analyzer)
+
+    def persist(self, analyzer: "Analyzer", state: State) -> None:
+        with self._lock:
+            self._states[analyzer] = state
+
+    def __repr__(self) -> str:
+        with self._lock:
+            keys = ", ".join(repr(k) for k in self._states)
+        return f"InMemoryStateProvider({keys})"

@@ -250,11 +250,17 @@ class Table:
             elif s.dtype == np.bool_ or str(s.dtype) == "boolean":
                 arr = s.fillna(False).to_numpy(dtype=np.bool_)
                 cols.append(Column(str(name), ColumnType.BOOLEAN, arr, valid))
-            elif np.issubdtype(s.dtype, np.integer) or str(s.dtype).startswith(
-                ("Int", "UInt")
+            elif str(s.dtype).startswith(("Int", "UInt")) or (
+                isinstance(s.dtype, np.dtype) and np.issubdtype(s.dtype, np.integer)
             ):
                 arr = s.fillna(0).to_numpy(dtype=np.int64)
                 cols.append(Column(str(name), ColumnType.LONG, arr, valid))
+            elif str(s.dtype).startswith("Float"):
+                # pandas nullable Float32/Float64 extension dtypes
+                arr = s.to_numpy(dtype=np.float64, na_value=np.nan)
+                valid = valid & ~np.isnan(np.where(valid, arr, 0.0))
+                arr = np.where(valid, arr, 0.0)
+                cols.append(Column(str(name), ColumnType.DOUBLE, arr, valid))
             else:
                 arr = s.to_numpy(dtype=np.float64)
                 valid = valid & ~np.isnan(np.where(valid, arr, 0.0))

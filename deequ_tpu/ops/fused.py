@@ -1,0 +1,152 @@
+"""The fused scan: N analyzers, ONE compiled XLA computation per pass.
+
+This is the TPU-native analogue of the reference's scan-sharing optimizer
+(reference: runners/AnalysisRunner.scala:279-326 — all scan-shareable
+analyzers run in a single `df.agg(...)` with offset arithmetic). Here the
+"offsets" are pytree structure: every analyzer contributes a device_reduce
+over a shared, deduplicated set of input arrays, XLA CSE merges the common
+subexpressions (masks, counts), and one program per batch produces every
+partial state at once.
+
+Cross-batch folding happens host-side in float64 via the same merge_agg
+formulas (numpy namespace) — the driver-side semigroup fold, exactly the
+role the reference's `State.sum` plays after Catalyst partial aggregation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deequ_tpu.analyzers.base import ScanShareableAnalyzer
+from deequ_tpu.analyzers.states import State
+from deequ_tpu.data.table import Table
+from deequ_tpu.ops import runtime
+
+DEFAULT_BATCH_SIZE = 1 << 22  # 4M rows: < 2^24 so f32 counts stay exact
+
+_FUSED_CACHE: Dict[Any, Any] = {}
+
+
+def _pad_size(n: int, batch_size: int) -> int:
+    """Round up to a power of two (min 8): few compiled shapes, no
+    per-tail recompilation."""
+    size = 8
+    while size < n:
+        size *= 2
+    return min(size, max(batch_size, 8))
+
+
+def get_fused_fn(analyzers: Sequence[ScanShareableAnalyzer]):
+    key = (tuple(repr(a) for a in analyzers), bool(jax.config.jax_enable_x64))
+    fn = _FUSED_CACHE.get(key)
+    if fn is None:
+
+        def fused(inputs):
+            return tuple(a.device_reduce(inputs, jnp) for a in analyzers)
+
+        fn = jax.jit(fused)
+        _FUSED_CACHE[key] = fn
+    return fn
+
+
+class AnalyzerRunResult:
+    """Outcome of one analyzer in a pass: a state (possibly None = empty)
+    or an error."""
+
+    def __init__(
+        self,
+        analyzer: ScanShareableAnalyzer,
+        state: Optional[State] = None,
+        error: Optional[BaseException] = None,
+    ):
+        self.analyzer = analyzer
+        self.state = state
+        self.error = error
+
+    def state_or_raise(self) -> Optional[State]:
+        if self.error is not None:
+            raise self.error
+        return self.state
+
+
+def _to_f64(tree: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x, dtype=np.float64), tree
+    )
+
+
+class FusedScanPass:
+    """Runs a set of scan-shareable analyzers in one device pass."""
+
+    def __init__(
+        self,
+        analyzers: Sequence[ScanShareableAnalyzer],
+        batch_size: int = DEFAULT_BATCH_SIZE,
+    ):
+        self.analyzers = list(analyzers)
+        self.batch_size = batch_size
+
+    def run(self, table: Table) -> List[AnalyzerRunResult]:
+        # 1. collect input specs; an analyzer whose spec construction fails
+        #    (e.g. unparseable predicate) fails alone, not the pass
+        runnable_idx: List[int] = []
+        results: Dict[int, AnalyzerRunResult] = {}
+        specs: Dict[str, Any] = {}
+        for i, analyzer in enumerate(self.analyzers):
+            try:
+                analyzer_specs = analyzer.input_specs()
+            except Exception as e:  # noqa: BLE001
+                results[i] = AnalyzerRunResult(analyzer, error=e)
+                continue
+            runnable_idx.append(i)
+            for spec in analyzer_specs:
+                specs.setdefault(spec.key, spec)
+
+        if runnable_idx:
+            runnable = [self.analyzers[i] for i in runnable_idx]
+            try:
+                aggs = self._run_pass(table, runnable, specs)
+                for i, analyzer, agg in zip(runnable_idx, runnable, aggs):
+                    results[i] = AnalyzerRunResult(
+                        analyzer, state=analyzer.state_from_aggregates(agg)
+                    )
+            except Exception as e:  # noqa: BLE001
+                # a runtime failure of the shared pass fails every analyzer in
+                # it (reference: AnalysisRunner.scala:310-313)
+                for i, analyzer in zip(runnable_idx, runnable):
+                    results[i] = AnalyzerRunResult(analyzer, error=e)
+
+        return [results[i] for i in range(len(self.analyzers))]
+
+    def _run_pass(self, table: Table, analyzers, specs) -> List[Any]:
+        fused = get_fused_fn(analyzers)
+        dtype = runtime.compute_dtype()
+        runtime.record_pass("scan:" + ",".join(a.name for a in analyzers))
+
+        total: Optional[List[Any]] = None
+        for batch in table.batches(self.batch_size):
+            padded = _pad_size(batch.num_rows, self.batch_size)
+            inputs: Dict[str, jnp.ndarray] = {}
+            for key, spec in specs.items():
+                arr = spec.build(batch)
+                arr = runtime.pad_to(np.asarray(arr), padded)
+                if arr.dtype == np.bool_ or np.issubdtype(arr.dtype, np.integer):
+                    inputs[key] = jnp.asarray(arr)
+                else:
+                    inputs[key] = jnp.asarray(arr.astype(dtype))
+            runtime.record_launch()
+            batch_aggs = jax.device_get(fused(inputs))
+            batch_aggs = [_to_f64(t) for t in batch_aggs]
+            if total is None:
+                total = batch_aggs
+            else:
+                total = [
+                    a.merge_agg(t, b, np)
+                    for a, t, b in zip(analyzers, total, batch_aggs)
+                ]
+        assert total is not None  # batches() always yields
+        return total

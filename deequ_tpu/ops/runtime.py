@@ -1,0 +1,94 @@
+"""Execution-engine runtime: dtypes, the pass monitor, jit cache keys.
+
+The monitor is the production analogue of the reference's test-only
+SparkMonitor job/stage listener (reference:
+src/test/scala/com/amazon/deequ/SparkMonitor.scala:25-75): it counts fused
+device passes and program launches so scan-sharing is an *asserted*
+property (SURVEY.md §6 efficiency invariants).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def compute_dtype() -> jnp.dtype:
+    """float64 when x64 is live (CPU tests / parity), float32 on TPU.
+
+    Per-batch reductions are XLA tree-reductions (error ~ eps·log n); the
+    cross-batch fold happens host-side in float64 either way, so f32 device
+    partials stay accurate as long as batches are < 2^24 rows.
+    """
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+MAX_F32_EXACT_COUNT_BATCH = 1 << 24  # f32 integers exact below 2^24
+
+
+@dataclass
+class ExecutionStats:
+    """Counts of engine work during a monitored block."""
+
+    device_passes: int = 0  # one per fused scan over a dataset (≈ Spark job)
+    device_launches: int = 0  # one per compiled-program invocation (per batch)
+    group_passes: int = 0  # one per group-by frequency computation
+    pass_labels: List[str] = field(default_factory=list)
+
+    @property
+    def jobs(self) -> int:
+        return self.device_passes + self.group_passes
+
+
+_local = threading.local()
+
+
+def _stack() -> List[ExecutionStats]:
+    if not hasattr(_local, "stack"):
+        _local.stack = []
+    return _local.stack
+
+
+@contextlib.contextmanager
+def monitored() -> Iterator[ExecutionStats]:
+    """Collect engine-execution counts for everything run inside the block."""
+    stats = ExecutionStats()
+    _stack().append(stats)
+    try:
+        yield stats
+    finally:
+        _stack().pop()
+
+
+def record_pass(label: str) -> None:
+    for stats in _stack():
+        stats.device_passes += 1
+        stats.pass_labels.append(label)
+
+
+def record_launch() -> None:
+    for stats in _stack():
+        stats.device_launches += 1
+
+
+def record_group_pass(label: str) -> None:
+    for stats in _stack():
+        stats.group_passes += 1
+        stats.pass_labels.append(f"group:{label}")
+
+
+def pad_to(arr: np.ndarray, size: int) -> np.ndarray:
+    """Pad a 1-D host array to `size` rows (content irrelevant: padded rows
+    carry where/valid = False so they never contribute to reductions).
+    Keeps one compiled shape per batch size instead of one per tail."""
+    n = len(arr)
+    if n == size:
+        return arr
+    pad = np.zeros(size - n, dtype=arr.dtype)
+    return np.concatenate([arr, pad])

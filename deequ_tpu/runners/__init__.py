@@ -1,0 +1,4 @@
+from deequ_tpu.runners.context import AnalyzerContext
+from deequ_tpu.runners.analysis_runner import AnalysisRunner
+
+__all__ = ["AnalyzerContext", "AnalysisRunner"]

@@ -1,0 +1,74 @@
+"""Fluent builder for analysis runs.
+
+reference: runners/AnalysisRunBuilder.scala:26-186 (incl. the repository
+variant's reuse/save options).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, List, Optional, Sequence
+
+from deequ_tpu.analyzers.base import Analyzer
+from deequ_tpu.data.table import Table
+from deequ_tpu.runners.context import AnalyzerContext
+
+if TYPE_CHECKING:
+    from deequ_tpu.analyzers.state_provider import StateLoader, StatePersister
+    from deequ_tpu.repository.base import MetricsRepository, ResultKey
+
+
+class AnalysisRunBuilder:
+    def __init__(self, data: Table):
+        self._data = data
+        self._analyzers: List[Analyzer] = []
+        self._metrics_repository: Optional["MetricsRepository"] = None
+        self._reuse_key: Optional["ResultKey"] = None
+        self._fail_if_results_missing = False
+        self._save_key: Optional["ResultKey"] = None
+        self._aggregate_with: Optional["StateLoader"] = None
+        self._save_states_with: Optional["StatePersister"] = None
+
+    def add_analyzer(self, analyzer: Analyzer) -> "AnalysisRunBuilder":
+        self._analyzers.append(analyzer)
+        return self
+
+    def add_analyzers(self, analyzers: Sequence[Analyzer]) -> "AnalysisRunBuilder":
+        self._analyzers.extend(analyzers)
+        return self
+
+    def aggregate_with(self, loader: "StateLoader") -> "AnalysisRunBuilder":
+        self._aggregate_with = loader
+        return self
+
+    def save_states_with(self, persister: "StatePersister") -> "AnalysisRunBuilder":
+        self._save_states_with = persister
+        return self
+
+    def use_repository(self, repository: "MetricsRepository") -> "AnalysisRunBuilder":
+        self._metrics_repository = repository
+        return self
+
+    def reuse_existing_results_for_key(
+        self, key: "ResultKey", fail_if_results_missing: bool = False
+    ) -> "AnalysisRunBuilder":
+        self._reuse_key = key
+        self._fail_if_results_missing = fail_if_results_missing
+        return self
+
+    def save_or_append_result(self, key: "ResultKey") -> "AnalysisRunBuilder":
+        self._save_key = key
+        return self
+
+    def run(self) -> AnalyzerContext:
+        from deequ_tpu.runners.analysis_runner import AnalysisRunner
+
+        return AnalysisRunner.do_analysis_run(
+            self._data,
+            self._analyzers,
+            aggregate_with=self._aggregate_with,
+            save_states_with=self._save_states_with,
+            metrics_repository=self._metrics_repository,
+            reuse_existing_results_for_key=self._reuse_key,
+            fail_if_results_missing=self._fail_if_results_missing,
+            save_or_append_results_with_key=self._save_key,
+        )

@@ -1,0 +1,216 @@
+"""AnalysisRunner: the scheduler/optimizer of the metrics engine.
+
+Pipeline (reference: runners/AnalysisRunner.scala:98-193):
+  1. skip analyzers whose metrics already exist in the repository,
+  2. partition out analyzers with failing preconditions -> failure metrics,
+  3. split grouping vs scanning analyzers,
+  4. run ALL scan-shareable analyzers in ONE fused device pass,
+  5. one frequency computation per distinct grouping-column-set, shared by
+     every grouping analyzer over it,
+  6. merge with previous results; save to repository.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
+
+from deequ_tpu.analyzers.base import Analyzer, Preconditions, ScanShareableAnalyzer
+from deequ_tpu.core.metrics import Metric
+from deequ_tpu.data.table import Table
+from deequ_tpu.ops.fused import FusedScanPass
+from deequ_tpu.runners.context import AnalyzerContext
+
+if TYPE_CHECKING:
+    from deequ_tpu.analyzers.state_provider import StateLoader, StatePersister
+    from deequ_tpu.repository.base import MetricsRepository, ResultKey
+
+
+class AnalysisRunner:
+    @staticmethod
+    def on_data(table: Table) -> "AnalysisRunBuilder":
+        from deequ_tpu.runners.analysis_run_builder import AnalysisRunBuilder
+
+        return AnalysisRunBuilder(table)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def do_analysis_run(
+        data: Table,
+        analyzers: Sequence[Analyzer],
+        aggregate_with: Optional["StateLoader"] = None,
+        save_states_with: Optional["StatePersister"] = None,
+        metrics_repository: Optional["MetricsRepository"] = None,
+        reuse_existing_results_for_key: Optional["ResultKey"] = None,
+        fail_if_results_missing: bool = False,
+        save_or_append_results_with_key: Optional["ResultKey"] = None,
+    ) -> AnalyzerContext:
+        if not analyzers:
+            return AnalyzerContext.empty()
+
+        # deduplicate, preserving order
+        seen = set()
+        unique: List[Analyzer] = []
+        for a in analyzers:
+            if a not in seen:
+                seen.add(a)
+                unique.append(a)
+        analyzers = unique
+
+        # 1. repository reuse (reference: AnalysisRunner.scala:116-135)
+        reused = AnalyzerContext.empty()
+        if metrics_repository is not None and reuse_existing_results_for_key is not None:
+            existing = metrics_repository.load_by_key(reuse_existing_results_for_key)
+            if existing is not None:
+                reused_map = {
+                    a: existing.metric_map[a]
+                    for a in analyzers
+                    if a in existing.metric_map
+                }
+                reused = AnalyzerContext(reused_map)
+            if fail_if_results_missing:
+                missing = [a for a in analyzers if a not in reused.metric_map]
+                if missing:
+                    raise RuntimeError(
+                        "Could not find all necessary results in the "
+                        "MetricsRepository, the calculation of the metrics "
+                        f"for these analyzers would be needed: "
+                        f"{', '.join(repr(a) for a in missing)}"
+                    )
+        analyzers = [a for a in analyzers if a not in reused.metric_map]
+
+        # 2. preconditions (reference: AnalysisRunner.scala:137-147)
+        passed: List[Analyzer] = []
+        failure_map: Dict[Analyzer, Metric] = {}
+        for a in analyzers:
+            err = Preconditions.find_first_failing(data, a.preconditions())
+            if err is None:
+                passed.append(a)
+            else:
+                failure_map[a] = a.to_failure_metric(err)
+        precondition_failures = AnalyzerContext(failure_map)
+
+        # 3. grouping vs scanning (reference: AnalysisRunner.scala:148-150)
+        from deequ_tpu.analyzers.grouping import GroupingAnalyzer
+
+        grouping = [a for a in passed if isinstance(a, GroupingAnalyzer)]
+        scanning = [a for a in passed if not isinstance(a, GroupingAnalyzer)]
+
+        # 4. fused scan pass (reference: AnalysisRunner.scala:279-326)
+        scanning_results = AnalysisRunner._run_scanning_analyzers(
+            data, scanning, aggregate_with, save_states_with
+        )
+
+        # 5. one frequency pass per grouping-column-set
+        #    (reference: AnalysisRunner.scala:164-180, 249-277)
+        grouping_results = AnalyzerContext.empty()
+        if grouping:
+            from deequ_tpu.runners.grouping_runner import run_grouping_analyzers
+
+            grouping_results = run_grouping_analyzers(
+                data, grouping, aggregate_with, save_states_with
+            )
+
+        context = (
+            reused + precondition_failures + scanning_results + grouping_results
+        )
+
+        # 6. save (reference: AnalysisRunner.scala:182-230)
+        if metrics_repository is not None and save_or_append_results_with_key is not None:
+            AnalysisRunner._save_or_append(
+                metrics_repository, save_or_append_results_with_key, context
+            )
+        return context
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _run_scanning_analyzers(
+        data: Table,
+        analyzers: Sequence[Analyzer],
+        aggregate_with: Optional["StateLoader"],
+        save_states_with: Optional["StatePersister"],
+    ) -> AnalyzerContext:
+        if not analyzers:
+            return AnalyzerContext.empty()
+
+        shareable = [a for a in analyzers if isinstance(a, ScanShareableAnalyzer)]
+        others = [a for a in analyzers if not isinstance(a, ScanShareableAnalyzer)]
+
+        metrics: Dict[Analyzer, Metric] = {}
+        if shareable:
+            results = FusedScanPass(shareable).run(data)
+            for result in results:
+                analyzer = result.analyzer
+                if result.error is not None:
+                    metrics[analyzer] = analyzer.to_failure_metric(result.error)
+                else:
+                    metrics[analyzer] = analyzer.calculate_metric(
+                        result.state, aggregate_with, save_states_with
+                    )
+        for analyzer in others:
+            metrics[analyzer] = analyzer.calculate(
+                data, aggregate_with, save_states_with
+            )
+        return AnalyzerContext(metrics)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def run_on_aggregated_states(
+        schema_table: Table,
+        analyzers: Sequence[Analyzer],
+        state_loaders: Sequence["StateLoader"],
+        save_states_with: Optional["StatePersister"] = None,
+        metrics_repository: Optional["MetricsRepository"] = None,
+        save_or_append_results_with_key: Optional["ResultKey"] = None,
+    ) -> AnalyzerContext:
+        """Metrics purely from merged states — NO data scan
+        (reference: runners/AnalysisRunner.scala:375-446)."""
+        from deequ_tpu.analyzers.state_provider import InMemoryStateProvider
+
+        if not analyzers or not state_loaders:
+            return AnalyzerContext.empty()
+
+        # precondition check against the schema
+        passed: List[Analyzer] = []
+        failure_map: Dict[Analyzer, Metric] = {}
+        for a in analyzers:
+            err = Preconditions.find_first_failing(schema_table, a.preconditions())
+            if err is None:
+                passed.append(a)
+            else:
+                failure_map[a] = a.to_failure_metric(err)
+
+        aggregated = InMemoryStateProvider()
+        for analyzer in passed:
+            for loader in state_loaders:
+                state = loader.load(analyzer)
+                if state is None:
+                    continue
+                existing = aggregated.load(analyzer)
+                merged = existing.merge(state) if existing is not None else state
+                aggregated.persist(analyzer, merged)
+
+        metrics: Dict[Analyzer, Metric] = dict(failure_map)
+        for analyzer in passed:
+            state = aggregated.load(analyzer)
+            if save_states_with is not None and state is not None:
+                save_states_with.persist(analyzer, state)
+            metrics[analyzer] = analyzer.compute_metric_from(state)
+
+        context = AnalyzerContext(metrics)
+        if metrics_repository is not None and save_or_append_results_with_key is not None:
+            AnalysisRunner._save_or_append(
+                metrics_repository, save_or_append_results_with_key, context
+            )
+        return context
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _save_or_append(
+        repository: "MetricsRepository",
+        key: "ResultKey",
+        context: AnalyzerContext,
+    ) -> None:
+        """Upsert semantics (reference: AnalysisRunner.scala:195-213)."""
+        existing = repository.load_by_key(key)
+        combined = (existing + context) if existing is not None else context
+        repository.save(key, combined)

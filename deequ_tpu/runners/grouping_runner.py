@@ -1,0 +1,34 @@
+"""Grouping-analyzer execution: one frequency computation per distinct
+grouping-column-set, shared by every analyzer over it.
+
+reference: runners/AnalysisRunner.scala:164-180 (grouping by column set),
+:249-277 (runGroupingAnalyzers), :466-534 (shared aggregation over the
+frequencies table). Until the full frequency sharing lands, analyzers run
+individually with per-analyzer failure capture.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict, Optional, Sequence
+
+from deequ_tpu.core.metrics import Metric
+from deequ_tpu.data.table import Table
+from deequ_tpu.runners.context import AnalyzerContext
+
+if TYPE_CHECKING:
+    from deequ_tpu.analyzers.grouping import GroupingAnalyzer
+    from deequ_tpu.analyzers.state_provider import StateLoader, StatePersister
+
+
+def run_grouping_analyzers(
+    data: Table,
+    analyzers: Sequence["GroupingAnalyzer"],
+    aggregate_with: Optional["StateLoader"] = None,
+    save_states_with: Optional["StatePersister"] = None,
+) -> AnalyzerContext:
+    metrics: Dict[object, Metric] = {}
+    for analyzer in analyzers:
+        metrics[analyzer] = analyzer.calculate(
+            data, aggregate_with, save_states_with
+        )
+    return AnalyzerContext(metrics)

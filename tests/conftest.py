@@ -16,6 +16,16 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "true")
 
+# jaxtyping's pytest plugin imports jax before this conftest runs, so the
+# env var alone is too late for x64 — push the (possibly user-overridden)
+# env value through the live config (safe post-import; the backend is not
+# initialized yet, so the platform/device env vars above still take effect).
+import jax  # noqa: E402
+
+jax.config.update(
+    "jax_enable_x64", os.environ["JAX_ENABLE_X64"].lower() in ("1", "true")
+)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

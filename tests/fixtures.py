@@ -11,12 +11,12 @@ from deequ_tpu.data.table import ColumnType, Table
 
 
 def get_df_missing() -> Table:
-    # 12 rows; att1 has 6 non-null, att2 has 6 non-null
+    # 12 rows; att1 has 6 non-null, att2 has 9 non-null
     return Table.from_pydict(
         {
             "item": [str(i) for i in range(1, 13)],
-            "att1": ["a", None, "b", "a", "a", None, "b", "b", "b", None, "b", None],
-            "att2": ["f", "d", "d", None, "f", "f", None, "d", None, "c", None, None],
+            "att1": ["a", "b", None, "a", "a", None, None, "b", "a", None, None, None],
+            "att2": ["f", "d", "f", None, "f", "d", "d", None, "f", None, "f", "d"],
         }
     )
 
@@ -25,8 +25,8 @@ def get_df_full() -> Table:
     return Table.from_pydict(
         {
             "item": ["1", "2", "3", "4"],
-            "att1": ["a", "b", "a", "a"],
-            "att2": ["c", "d", "d", "f"],
+            "att1": ["a", "a", "a", "b"],
+            "att2": ["c", "c", "c", "d"],
         }
     )
 
@@ -46,10 +46,10 @@ def get_df_with_unique_columns() -> Table:
         {
             "unique": ["1", "2", "3", "4", "5", "6"],
             "nonUnique": ["0", "0", "0", "5", "6", "7"],
-            "nonUniqueWithNulls": [None, "0", "0", None, "5", "6"],
-            "uniqueWithNulls": ["1", None, "3", None, "5", "6"],
-            "onlyUniqueWithOtherNonUnique": ["1", "2", "3", "4", "5", "6"],
-            "halfUniqueCombinedWithNonUnique": ["0", "1", "2", "3", "4", "5"],
+            "nonUniqueWithNulls": ["3", "3", "3", None, None, None],
+            "uniqueWithNulls": ["1", "2", None, "3", "4", "5"],
+            "onlyUniqueWithOtherNonUnique": ["5", "6", "7", "0", "0", "0"],
+            "halfUniqueCombinedWithNonUnique": ["0", "0", "0", "4", "5", "6"],
         }
     )
 
@@ -57,8 +57,8 @@ def get_df_with_unique_columns() -> Table:
 def get_df_with_distinct_values() -> Table:
     return Table.from_pydict(
         {
-            "att1": ["a", None, "b", "b", None, "a"],
-            "att2": ["f", "d", "d", None, None, "f"],
+            "att1": ["a", "a", None, "b", "b", "c"],
+            "att2": [None, None, "x", "x", "x", "y"],
         }
     )
 
@@ -110,15 +110,15 @@ def get_basic_example_table() -> Table:
     return Table.from_pydict(
         {
             "id": [1, 2, 3, 4, 5],
-            "productName": ["Thingy A", "Thingy B", None, "Thingy D", "Thingy E"],
+            "name": ["Thingy A", "Thingy B", None, "Thingy D", "Thingy E"],
             "description": [
                 "awesome thing.",
                 "available at http://thingb.com",
                 None,
                 "checkout https://thingd.ca",
-                "click on https://thinge.ca",
+                None,
             ],
-            "priority": ["high", "low", "high", "low", "high"],
-            "numViews": [0, 0, 12, 123, 2],
+            "priority": ["high", None, "low", "low", "high"],
+            "numViews": [0, 0, 5, 10, 12],
         }
     )
