@@ -25,6 +25,10 @@ from deequ_tpu.core.metrics import (
     DistributionValue,
 )
 from deequ_tpu.data.table import Table, Column, ColumnType
+from deequ_tpu.checks.check import Check, CheckLevel, CheckStatus
+from deequ_tpu.verification.suite import VerificationSuite
+from deequ_tpu.verification.result import VerificationResult
+from deequ_tpu.constraints.constrainable_data_types import ConstrainableDataTypes
 
 __version__ = "0.1.0"
 
@@ -42,4 +46,10 @@ __all__ = [
     "Table",
     "Column",
     "ColumnType",
+    "Check",
+    "CheckLevel",
+    "CheckStatus",
+    "VerificationSuite",
+    "VerificationResult",
+    "ConstrainableDataTypes",
 ]

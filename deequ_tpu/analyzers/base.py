@@ -282,7 +282,19 @@ def where_spec(where: Optional[str]) -> InputSpec:
 class ScanShareableAnalyzer(Analyzer):
     """An analyzer whose per-batch work is expressible as a masked reduction
     that can be fused with others into one compiled pass
-    (reference: analyzers/Analyzer.scala:159-216)."""
+    (reference: analyzers/Analyzer.scala:159-216).
+
+    Two flavors share the single scan: device-reduced analyzers contribute
+    traced reductions to the fused XLA program; host-reduced analyzers
+    (``host_reduced = True``, e.g. quantile digests) fold a partial State
+    per batch on the host while the device program runs."""
+
+    host_reduced = False
+
+    def host_reduce(self, batch: "Table"):
+        """Host-side partial State for one (unpadded) batch; None = no
+        contribution. Only called when host_reduced is True."""
+        raise NotImplementedError
 
     def input_specs(self) -> List[InputSpec]:
         raise NotImplementedError

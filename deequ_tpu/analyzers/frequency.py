@@ -1,0 +1,365 @@
+"""Frequency-based (grouping) analyzers.
+
+The frequency computation is the engine's group-by:
+  SELECT cols, COUNT(*) FROM data WHERE all cols NOT NULL GROUP BY cols
+(reference: analyzers/GroupingAnalyzers.scala:44-81). Host-side, columns
+are dictionary-encoded and combined with ravel_multi_index, so the group-by
+is one vectorized np.unique over dense codes; the aggregations over the
+resulting counts array (uniqueness/distinctness/entropy/...) fuse into one
+device reduction shared by every analyzer on the same grouping columns
+(reference: AnalysisRunner.scala:466-534).
+
+State merge is a key-aligned counts sum — the dict analogue of the
+reference's null-safe outer join (GroupingAnalyzers.scala:128-148).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deequ_tpu.analyzers.base import COUNT_COL, Analyzer, Preconditions, entity_from
+from deequ_tpu.analyzers.grouping import GroupingAnalyzer
+from deequ_tpu.analyzers.states import State
+from deequ_tpu.core.maybe import Success
+from deequ_tpu.core.metrics import DoubleMetric, Entity, Metric
+from deequ_tpu.data.table import ColumnType, Table
+
+
+# ---------------------------------------------------------------------------
+# State
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FrequenciesAndNumRows(State):
+    """Group keys + counts + overall #rows
+    (reference: GroupingAnalyzers.scala:124-157)."""
+
+    columns: List[str]
+    keys: List[Tuple]  # one tuple of group-key values per group
+    counts: np.ndarray  # int64, aligned with keys
+    num_rows: int
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.keys)
+
+    def merge(self, other: "FrequenciesAndNumRows") -> "FrequenciesAndNumRows":
+        other_keys = other.keys
+        if self.columns != other.columns:
+            # align by column name (the dict analogue of the reference's
+            # name-based outer join); declared order may differ from the
+            # runner's sorted sharing order
+            if sorted(self.columns) != sorted(other.columns):
+                raise ValueError(
+                    f"cannot merge frequencies over {self.columns} with {other.columns}"
+                )
+            perm = [other.columns.index(c) for c in self.columns]
+            other_keys = [tuple(k[i] for i in perm) for k in other.keys]
+        combined: Dict[Tuple, int] = {}
+        for key, count in zip(self.keys, self.counts):
+            combined[key] = combined.get(key, 0) + int(count)
+        for key, count in zip(other_keys, other.counts):
+            combined[key] = combined.get(key, 0) + int(count)
+        keys = list(combined.keys())
+        counts = np.array([combined[k] for k in keys], dtype=np.int64)
+        return FrequenciesAndNumRows(
+            list(self.columns), keys, counts, self.num_rows + other.num_rows
+        )
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, FrequenciesAndNumRows):
+            return False
+        return (
+            self.columns == other.columns
+            and self.num_rows == other.num_rows
+            and dict(zip(self.keys, self.counts.tolist()))
+            == dict(zip(other.keys, other.counts.tolist()))
+        )
+
+
+def _column_key_values(col) -> Tuple[np.ndarray, np.ndarray]:
+    """(codes, uniques) with uniques as python-friendly scalars."""
+    codes, uniques = col.dict_encode()
+    if col.ctype == ColumnType.LONG:
+        uniques = np.array([int(u) for u in uniques], dtype=object)
+    elif col.ctype in (ColumnType.DOUBLE, ColumnType.DECIMAL):
+        uniques = np.array([float(u) for u in uniques], dtype=object)
+    elif col.ctype == ColumnType.BOOLEAN:
+        uniques = np.array([bool(u) for u in uniques], dtype=object)
+    else:
+        uniques = np.asarray(uniques, dtype=object)
+    return codes, uniques
+
+
+def compute_frequencies(
+    data: Table, grouping_columns: Sequence[str], num_rows: Optional[int] = None
+) -> FrequenciesAndNumRows:
+    """reference: GroupingAnalyzers.scala:53-80. Rows where ANY grouping
+    column is NULL are excluded from groups; num_rows counts all rows."""
+    from deequ_tpu.ops import runtime
+
+    runtime.record_group_pass(",".join(grouping_columns))
+
+    cols = [data.column(name) for name in grouping_columns]
+    valid = np.ones(data.num_rows, dtype=np.bool_)
+    for col in cols:
+        valid &= col.valid
+
+    encoded = [_column_key_values(col) for col in cols]
+    dims = [max(len(u), 1) for _, u in encoded]
+
+    if valid.any():
+        code_arrays = [np.where(valid, c, 0) for c, _ in encoded]
+        combined = np.ravel_multi_index(code_arrays, dims)[valid]
+        unique_codes, counts = np.unique(combined, return_counts=True)
+        unraveled = np.unravel_index(unique_codes, dims)
+        keys = [
+            tuple(encoded[j][1][unraveled[j][i]] for j in range(len(cols)))
+            for i in range(len(unique_codes))
+        ]
+        counts = counts.astype(np.int64)
+    else:
+        keys = []
+        counts = np.array([], dtype=np.int64)
+
+    total = num_rows if num_rows is not None else data.num_rows
+    return FrequenciesAndNumRows(list(grouping_columns), keys, counts, total)
+
+
+# ---------------------------------------------------------------------------
+# Analyzer bases
+# ---------------------------------------------------------------------------
+
+
+class FrequencyBasedAnalyzer(GroupingAnalyzer):
+    """reference: GroupingAnalyzers.scala:28-41."""
+
+    def grouping_columns(self) -> List[str]:
+        return list(self.columns)
+
+    @property
+    def instance(self) -> str:
+        return ",".join(self.columns)
+
+    @property
+    def entity(self) -> Entity:
+        return entity_from(self.columns)
+
+    def preconditions(self) -> List[Callable[[Table], None]]:
+        return [Preconditions.at_least_one(self.columns)] + [
+            Preconditions.has_column(c) for c in self.columns
+        ]
+
+    def compute_state_from(self, table: Table) -> Optional[FrequenciesAndNumRows]:
+        return compute_frequencies(table, self.grouping_columns())
+
+
+class ScanShareableFrequencyBasedAnalyzer(FrequencyBasedAnalyzer):
+    """Aggregations over the shared frequencies table
+    (reference: GroupingAnalyzers.scala:84-121). `freq_reduce` is generic
+    over the array namespace so it fuses into one device program per
+    grouping set and also serves host evaluation."""
+
+    def freq_reduce(self, counts, num_rows: int, xp) -> Any:
+        raise NotImplementedError
+
+    def metric_from_freq_agg(self, agg: Any, state: FrequenciesAndNumRows) -> Metric:
+        raise NotImplementedError
+
+    def compute_metric_from(self, state: Optional[FrequenciesAndNumRows]) -> Metric:
+        if state is None:
+            return self.empty_state_failure()
+        from deequ_tpu.ops.freq_agg import run_shared_freq_agg
+
+        return run_shared_freq_agg(state, [self])[0]
+
+    def to_success_metric(self, value: float) -> DoubleMetric:
+        return DoubleMetric(self.entity, self.name, self.instance, Success(value))
+
+
+# ---------------------------------------------------------------------------
+# Concrete frequency analyzers
+# ---------------------------------------------------------------------------
+
+
+def _single_or_seq(columns) -> List[str]:
+    if isinstance(columns, str):
+        return [columns]
+    return list(columns)
+
+
+def _scala_list_repr(columns: Sequence[str]) -> str:
+    return f"List({', '.join(columns)})"
+
+
+class Uniqueness(ScanShareableFrequencyBasedAnalyzer):
+    """Fraction of values occurring exactly once
+    (reference: analyzers/Uniqueness.scala:26)."""
+
+    def __init__(self, columns):
+        self.columns = _single_or_seq(columns)
+
+    @property
+    def name(self) -> str:
+        return "Uniqueness"
+
+    def freq_reduce(self, counts, num_rows: int, xp) -> Any:
+        return {"unique": xp.sum(xp.asarray(counts == 1, dtype=counts.dtype))}
+
+    def metric_from_freq_agg(self, agg: Any, state: FrequenciesAndNumRows) -> Metric:
+        if state.num_groups == 0:
+            return self.empty_state_failure()  # SQL sum over empty -> NULL
+        return self.to_success_metric(float(agg["unique"]) / state.num_rows)
+
+    def __repr__(self) -> str:
+        return f"Uniqueness({_scala_list_repr(self.columns)})"
+
+
+class Distinctness(ScanShareableFrequencyBasedAnalyzer):
+    """Fraction of distinct values (reference: analyzers/Distinctness.scala:29)."""
+
+    def __init__(self, columns):
+        self.columns = _single_or_seq(columns)
+
+    @property
+    def name(self) -> str:
+        return "Distinctness"
+
+    def freq_reduce(self, counts, num_rows: int, xp) -> Any:
+        return {"distinct": xp.sum(xp.asarray(counts >= 1, dtype=counts.dtype))}
+
+    def metric_from_freq_agg(self, agg: Any, state: FrequenciesAndNumRows) -> Metric:
+        if state.num_groups == 0:
+            return self.empty_state_failure()
+        return self.to_success_metric(float(agg["distinct"]) / state.num_rows)
+
+    def __repr__(self) -> str:
+        return f"Distinctness({_scala_list_repr(self.columns)})"
+
+
+class UniqueValueRatio(ScanShareableFrequencyBasedAnalyzer):
+    """#unique / #distinct groups (reference: analyzers/UniqueValueRatio.scala:25)."""
+
+    def __init__(self, columns):
+        self.columns = _single_or_seq(columns)
+
+    @property
+    def name(self) -> str:
+        return "UniqueValueRatio"
+
+    def freq_reduce(self, counts, num_rows: int, xp) -> Any:
+        return {
+            "unique": xp.sum(xp.asarray(counts == 1, dtype=counts.dtype)),
+            "groups": xp.sum(xp.asarray(counts >= 1, dtype=counts.dtype)),
+        }
+
+    def metric_from_freq_agg(self, agg: Any, state: FrequenciesAndNumRows) -> Metric:
+        if state.num_groups == 0:
+            return self.empty_state_failure()
+        return self.to_success_metric(float(agg["unique"]) / float(agg["groups"]))
+
+    def __repr__(self) -> str:
+        return f"UniqueValueRatio({_scala_list_repr(self.columns)})"
+
+
+class CountDistinct(ScanShareableFrequencyBasedAnalyzer):
+    """#groups; count(*) never nulls, so empty -> 0.0
+    (reference: analyzers/CountDistinct.scala:24)."""
+
+    def __init__(self, columns):
+        self.columns = _single_or_seq(columns)
+
+    @property
+    def name(self) -> str:
+        return "CountDistinct"
+
+    def freq_reduce(self, counts, num_rows: int, xp) -> Any:
+        return {"groups": xp.sum(xp.asarray(counts >= 1, dtype=counts.dtype))}
+
+    def metric_from_freq_agg(self, agg: Any, state: FrequenciesAndNumRows) -> Metric:
+        return self.to_success_metric(float(agg["groups"]))
+
+    def __repr__(self) -> str:
+        return f"CountDistinct({_scala_list_repr(self.columns)})"
+
+
+class Entropy(ScanShareableFrequencyBasedAnalyzer):
+    """-Σ (c/N)·ln(c/N) with N = total rows incl. nulls, exactly like the
+    reference's UDF over group counts (reference: analyzers/Entropy.scala:28-41)."""
+
+    def __init__(self, column: str):
+        self.columns = [column]
+
+    @property
+    def name(self) -> str:
+        return "Entropy"
+
+    def freq_reduce(self, counts, num_rows, xp) -> Any:
+        n = xp.maximum(xp.asarray(num_rows, dtype=counts.dtype), 1)
+        p = counts / n
+        safe_p = xp.where(p > 0, p, 1.0)
+        return {"entropy": xp.sum(xp.where(p > 0, -safe_p * xp.log(safe_p), 0.0))}
+
+    def metric_from_freq_agg(self, agg: Any, state: FrequenciesAndNumRows) -> Metric:
+        if state.num_groups == 0:
+            return self.empty_state_failure()
+        return self.to_success_metric(float(agg["entropy"]))
+
+    def __repr__(self) -> str:
+        # Scala: case class Entropy(column: String)
+        return f"Entropy({self.columns[0]})"
+
+
+class MutualInformation(FrequencyBasedAnalyzer):
+    """Σ pxy·ln(pxy/(px·py)) over the joint frequencies; NOT shareable
+    (joins marginals — reference: analyzers/MutualInformation.scala:35-90)."""
+
+    def __init__(self, column_a, column_b=None):
+        if column_b is None:
+            self.columns = _single_or_seq(column_a)
+        else:
+            self.columns = [column_a, column_b]
+
+    @property
+    def name(self) -> str:
+        return "MutualInformation"
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.MULTICOLUMN
+
+    def preconditions(self) -> List[Callable[[Table], None]]:
+        return [Preconditions.exactly_n_columns(self.columns, 2)] + super().preconditions()
+
+    def compute_metric_from(self, state: Optional[FrequenciesAndNumRows]) -> Metric:
+        if state is None or state.num_groups == 0:
+            return self.empty_state_failure()
+        from deequ_tpu.ops import runtime
+
+        runtime.record_pass("freq-agg:MutualInformation")
+        total = state.num_rows
+        # state columns may be sorted differently than self.columns
+        ia = state.columns.index(self.columns[0])
+        ib = state.columns.index(self.columns[1])
+        keys_a = [k[ia] for k in state.keys]
+        keys_b = [k[ib] for k in state.keys]
+        counts = state.counts.astype(np.float64)
+
+        _, codes_a = np.unique(np.array(keys_a, dtype=object), return_inverse=True)
+        _, codes_b = np.unique(np.array(keys_b, dtype=object), return_inverse=True)
+        marg_a = np.bincount(codes_a, weights=counts)
+        marg_b = np.bincount(codes_b, weights=counts)
+
+        pxy = counts / total
+        px = marg_a[codes_a] / total
+        py = marg_b[codes_b] / total
+        value = float(np.sum(pxy * np.log(pxy / (px * py))))
+        return DoubleMetric(self.entity, self.name, self.instance, Success(value))
+
+    def __repr__(self) -> str:
+        return f"MutualInformation({_scala_list_repr(self.columns)})"

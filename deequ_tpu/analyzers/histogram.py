@@ -1,0 +1,159 @@
+"""Histogram analyzer: full value distribution with top-N detail bins.
+
+reference: analyzers/Histogram.scala:38-116. Unlike the grouping analyzers
+it keeps NULL rows (as the "NullValue" bin) and stringifies values the way
+Spark's cast-to-string does.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from deequ_tpu.analyzers.base import Analyzer, Preconditions
+from deequ_tpu.analyzers.frequency import FrequenciesAndNumRows
+from deequ_tpu.core.exceptions import IllegalAnalyzerParameterException, wrap_if_necessary
+from deequ_tpu.core.maybe import Failure, Success, Try
+from deequ_tpu.core.metrics import (
+    Distribution,
+    DistributionValue,
+    Entity,
+    HistogramMetric,
+    Metric,
+)
+from deequ_tpu.data.table import ColumnType, Table
+
+NULL_FIELD_REPLACEMENT = "NullValue"
+MAXIMUM_ALLOWED_DETAIL_BINS = 1000
+
+
+def _stringify(value, ctype: ColumnType) -> str:
+    """Spark cast-to-string conventions for typed column values."""
+    if ctype == ColumnType.BOOLEAN:
+        return "true" if value else "false"
+    if ctype == ColumnType.LONG:
+        return str(int(value))
+    if ctype in (ColumnType.DOUBLE, ColumnType.DECIMAL):
+        return str(float(value))
+    return str(value)
+
+
+def _stringify_any(value) -> str:
+    """Stringify by the VALUE's type — binning udfs may map numeric input
+    to arbitrary labels."""
+    if isinstance(value, bool) or isinstance(value, np.bool_):
+        return "true" if value else "false"
+    if isinstance(value, (int, np.integer)):
+        return str(int(value))
+    if isinstance(value, (float, np.floating)):
+        return str(float(value))
+    return str(value)
+
+
+class Histogram(Analyzer):
+    def __init__(
+        self,
+        column: str,
+        binning_udf: Optional[Callable] = None,
+        max_detail_bins: int = MAXIMUM_ALLOWED_DETAIL_BINS,
+    ):
+        self.column = column
+        self.binning_udf = binning_udf
+        self.max_detail_bins = max_detail_bins
+
+    @property
+    def name(self) -> str:
+        return "Histogram"
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    @property
+    def entity(self) -> Entity:
+        return Entity.COLUMN
+
+    def preconditions(self) -> List[Callable[[Table], None]]:
+        def param_check(table: Table) -> None:
+            if self.max_detail_bins > MAXIMUM_ALLOWED_DETAIL_BINS:
+                raise IllegalAnalyzerParameterException(
+                    "Cannot return histogram values for more than "
+                    f"{MAXIMUM_ALLOWED_DETAIL_BINS} values"
+                )
+
+        return [param_check, Preconditions.has_column(self.column)]
+
+    def compute_state_from(self, table: Table) -> Optional[FrequenciesAndNumRows]:
+        from deequ_tpu.ops import runtime
+
+        runtime.record_group_pass(f"histogram:{self.column}")
+        col = table.column(self.column)
+        if self.binning_udf is None:
+            # vectorized fast path: group on dictionary codes, stringify
+            # only the (few) unique values
+            codes, uniques = col.dict_encode()
+            group_counts = np.bincount(codes + 1, minlength=len(uniques) + 1)
+            labels = [NULL_FIELD_REPLACEMENT] + [
+                _stringify(u, col.ctype) for u in uniques
+            ]
+            keys: List[tuple] = []
+            counts_list: List[int] = []
+            label_totals: Dict[str, int] = {}
+            for label, count in zip(labels, group_counts):
+                if count > 0:
+                    label_totals[label] = label_totals.get(label, 0) + int(count)
+            keys = [(label,) for label in label_totals]
+            counts = np.array(list(label_totals.values()), dtype=np.int64)
+        else:
+            values = np.empty(len(col), dtype=object)
+            for i in range(len(col)):
+                if not col.valid[i]:
+                    values[i] = NULL_FIELD_REPLACEMENT
+                else:
+                    values[i] = _stringify_any(self.binning_udf(col.values[i]))
+            if len(values):
+                uniques, ucounts = np.unique(values.astype(str), return_counts=True)
+            else:
+                uniques, ucounts = np.array([], dtype=str), np.array([], dtype=np.int64)
+            keys = [(str(u),) for u in uniques]
+            counts = ucounts.astype(np.int64)
+        return FrequenciesAndNumRows([self.column], keys, counts, table.num_rows)
+
+    def compute_metric_from(self, state: Optional[FrequenciesAndNumRows]) -> Metric:
+        if state is None:
+            from deequ_tpu.core.exceptions import EmptyStateException
+
+            return HistogramMetric(
+                Entity.COLUMN,
+                self.name,
+                self.column,
+                Failure(
+                    EmptyStateException(
+                        f"Empty state for analyzer {self!r}, all input values were NULL."
+                    )
+                ),
+            )
+
+        def build() -> Distribution:
+            bin_count = state.num_groups
+            order = np.argsort(state.counts, kind="stable")[::-1][: self.max_detail_bins]
+            details = {}
+            for i in order:
+                value = state.keys[i][0]
+                absolute = int(state.counts[i])
+                details[value] = DistributionValue(
+                    absolute, absolute / state.num_rows
+                )
+            return Distribution(details, number_of_bins=bin_count)
+
+        return HistogramMetric(Entity.COLUMN, self.name, self.column, Try.of(build))
+
+    def to_failure_metric(self, exception: BaseException) -> Metric:
+        return HistogramMetric(
+            Entity.COLUMN, self.name, self.column, Failure(wrap_if_necessary(exception))
+        )
+
+    def __repr__(self) -> str:
+        udf = "None" if self.binning_udf is None else f"Some({self.binning_udf})"
+        return f"Histogram({self.column},{udf},{self.max_detail_bins})"

@@ -1,0 +1,332 @@
+"""Sketch-based analyzers: bounded-memory approximations.
+
+ApproxCountDistinct: host hashes values (vectorized xxhash64), the device
+scatter-maxes HLL registers inside the fused pass, merges are register-wise
+max (reference: analyzers/ApproxCountDistinct.scala:47 + catalyst kernel).
+
+ApproxQuantile(s): per-batch KLL partial sketches folded on the host —
+the host-reduce stage of the fused pass (same single logical scan;
+reference: analyzers/ApproxQuantile.scala:49, ApproxQuantiles.scala:39).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deequ_tpu.analyzers.base import (
+    InputSpec,
+    Preconditions,
+    ScanShareableAnalyzer,
+    col_valid_spec,
+    col_values_spec,
+    render_where,
+    where_key,
+    where_spec,
+)
+from deequ_tpu.analyzers.states import DoubleValuedState, State
+from deequ_tpu.core.exceptions import IllegalAnalyzerParameterException
+from deequ_tpu.core.maybe import Success
+from deequ_tpu.core.metrics import DoubleMetric, Entity, KeyedDoubleMetric, Metric
+from deequ_tpu.data.table import Table
+from deequ_tpu.ops.sketches import hll
+from deequ_tpu.ops.sketches.kll import KLLSketch, k_for_error
+
+
+# ---------------------------------------------------------------------------
+# ApproxCountDistinct
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ApproxCountDistinctState(DoubleValuedState):
+    """HLL registers (reference: ApproxCountDistinct.scala:26 — merge is
+    register-wise max)."""
+
+    registers: np.ndarray
+
+    def merge(self, other: "ApproxCountDistinctState") -> "ApproxCountDistinctState":
+        return ApproxCountDistinctState(hll.merge_registers(self.registers, other.registers))
+
+    def metric_value(self) -> float:
+        return hll.estimate(self.registers)
+
+    def words(self) -> np.ndarray:
+        return hll.pack_words(self.registers)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ApproxCountDistinctState) and np.array_equal(
+            self.registers, other.registers
+        )
+
+    def __hash__(self) -> int:
+        return hash(self.registers.tobytes())
+
+
+def _hll_spec(column: str) -> InputSpec:
+    """One int32 per row packing (register idx << 6 | rank) so the column
+    is hashed exactly once per batch; invalid rows pack to 0 (idx 0,
+    rank 0 — a no-op for the scatter-max)."""
+
+    def build(t: Table) -> np.ndarray:
+        col = t.column(column)
+        hashes = hll.hash_column(col.values, col.valid)
+        idx_v, rank_v = hll.registers_from_hashes(hashes)
+        packed = np.zeros(len(col), dtype=np.int32)
+        packed[col.valid] = (idx_v << 6) | rank_v
+        return packed
+
+    return InputSpec(key=f"hll:{column}", build=build)
+
+
+@dataclass(frozen=True)
+class ApproxCountDistinct(ScanShareableAnalyzer):
+    """HLL++ distinct estimate (reference: analyzers/ApproxCountDistinct.scala:47)."""
+
+    column: str
+    where: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return "ApproxCountDistinct"
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    def preconditions(self) -> List[Callable[[Table], None]]:
+        return [Preconditions.has_column(self.column)]
+
+    def input_specs(self) -> List[InputSpec]:
+        return [_hll_spec(self.column), where_spec(self.where)]
+
+    def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        packed = xp.asarray(inputs[f"hll:{self.column}"])
+        w = inputs[where_key(self.where)]
+        idx = packed >> 6
+        rank = packed & 0x3F
+        masked_rank = xp.where(xp.asarray(w), rank, 0)
+        if xp is np:
+            registers = np.zeros(hll.M, dtype=np.int32)
+            np.maximum.at(registers, np.asarray(idx), masked_rank)
+            return {"registers": registers}
+        registers = xp.zeros(hll.M, dtype=masked_rank.dtype).at[idx].max(masked_rank)
+        return {"registers": registers}
+
+    def merge_agg(self, a: Any, b: Any, xp) -> Any:
+        return {"registers": xp.maximum(a["registers"], b["registers"])}
+
+    def state_from_aggregates(self, agg: Any) -> Optional[State]:
+        return ApproxCountDistinctState(
+            np.asarray(agg["registers"]).astype(np.int32)
+        )
+
+    def compute_metric_from(self, state: Optional[State]) -> Metric:
+        if state is None:
+            return self.empty_state_failure()
+        return DoubleMetric(
+            self.entity, self.name, self.instance, Success(state.metric_value())
+        )
+
+    def __repr__(self) -> str:
+        return f"ApproxCountDistinct({self.column},{render_where(self.where)})"
+
+
+# ---------------------------------------------------------------------------
+# ApproxQuantile(s) — host-reduced members of the fused pass
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ApproxQuantileState(State):
+    """Mergeable quantile digest (reference: ApproxQuantile.scala:28-35)."""
+
+    digest: KLLSketch
+
+    def merge(self, other: "ApproxQuantileState") -> "ApproxQuantileState":
+        return ApproxQuantileState(self.digest.merge(other.digest))
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, ApproxQuantileState):
+            return False
+        k1, n1, l1 = self.digest.to_arrays()
+        k2, n2, l2 = other.digest.to_arrays()
+        return (
+            k1 == k2
+            and n1 == n2
+            and len(l1) == len(l2)
+            and all(np.array_equal(a, b) for a, b in zip(l1, l2))
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.digest.k, self.digest.n))
+
+
+def _quantile_param_check(quantile: float) -> Callable[[Table], None]:
+    def check(table: Table) -> None:
+        if not (0.0 <= quantile <= 1.0):
+            raise IllegalAnalyzerParameterException(
+                "Quantile parameter must be in the closed interval [0, 1]. "
+                f"Currently, the value is: {quantile}!"
+            )
+
+    return check
+
+
+def _relative_error_param_check(relative_error: float) -> Callable[[Table], None]:
+    def check(table: Table) -> None:
+        if not (0.0 <= relative_error <= 1.0):
+            raise IllegalAnalyzerParameterException(
+                "Relative error parameter must be in the closed interval [0, 1]. "
+                f"Currently, the value is: {relative_error}!"
+            )
+
+    return check
+
+
+_BATCH_SEED_COUNTER = [0]
+
+
+def _next_batch_seed() -> int:
+    """Distinct seed per batch sketch: KLL's error bound needs independent
+    compaction offsets across merged partials."""
+    _BATCH_SEED_COUNTER[0] += 1
+    return _BATCH_SEED_COUNTER[0]
+
+
+class _QuantileAnalyzerBase(ScanShareableAnalyzer):
+    """Shared host-reduce machinery: one KLL partial per batch."""
+
+    host_reduced = True
+
+    def input_specs(self) -> List[InputSpec]:
+        return [
+            col_values_spec(self.column),
+            col_valid_spec(self.column),
+            where_spec(self.where) if getattr(self, "where", None) is not None else where_spec(None),
+        ]
+
+    def host_reduce(self, batch: Table) -> Optional[State]:
+        col = batch.column(self.column)
+        values, valid = col.numeric_values()
+        mask = valid
+        where = getattr(self, "where", None)
+        if where is not None:
+            from deequ_tpu.data.expr import Predicate
+
+            mask = mask & Predicate(where).eval_mask(batch)
+        selected = values[mask]
+        if len(selected) == 0:
+            return None
+        sketch = KLLSketch(k=k_for_error(self.relative_error), seed=_next_batch_seed())
+        sketch.update_batch(selected)
+        return ApproxQuantileState(sketch)
+
+
+@dataclass(frozen=True)
+class ApproxQuantile(_QuantileAnalyzerBase):
+    """Single quantile (reference: analyzers/ApproxQuantile.scala:49)."""
+
+    column: str
+    quantile: float
+    relative_error: float = 0.01
+    where: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return "ApproxQuantile"
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    def preconditions(self) -> List[Callable[[Table], None]]:
+        return [
+            _quantile_param_check(self.quantile),
+            _relative_error_param_check(self.relative_error),
+            Preconditions.has_column(self.column),
+            Preconditions.is_numeric(self.column),
+        ]
+
+    def compute_metric_from(self, state: Optional[State]) -> Metric:
+        if state is None:
+            return self.empty_state_failure()
+        return DoubleMetric(
+            self.entity,
+            self.name,
+            self.instance,
+            Success(state.digest.quantile(self.quantile)),
+        )
+
+    def __repr__(self) -> str:
+        return f"ApproxQuantile({self.column},{self.quantile},{self.relative_error})"
+
+
+@dataclass(frozen=True)
+class ApproxQuantiles(_QuantileAnalyzerBase):
+    """Many quantiles from one digest -> KeyedDoubleMetric
+    (reference: analyzers/ApproxQuantiles.scala:39)."""
+
+    column: str
+    quantiles: Tuple[float, ...]
+    relative_error: float = 0.01
+
+    def __init__(self, column: str, quantiles, relative_error: float = 0.01):
+        object.__setattr__(self, "column", column)
+        object.__setattr__(self, "quantiles", tuple(quantiles))
+        object.__setattr__(self, "relative_error", relative_error)
+
+    @property
+    def name(self) -> str:
+        return "ApproxQuantiles"
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    def preconditions(self) -> List[Callable[[Table], None]]:
+        return (
+            [_quantile_param_check(q) for q in self.quantiles]
+            + [
+                _relative_error_param_check(self.relative_error),
+                Preconditions.has_column(self.column),
+                Preconditions.is_numeric(self.column),
+            ]
+        )
+
+    def compute_metric_from(self, state: Optional[State]) -> Metric:
+        if state is None:
+            from deequ_tpu.core.exceptions import EmptyStateException
+            from deequ_tpu.core.maybe import Failure
+
+            return KeyedDoubleMetric(
+                self.entity,
+                self.name,
+                self.instance,
+                Failure(
+                    EmptyStateException(
+                        f"Empty state for analyzer {self!r}, all input values were NULL."
+                    )
+                ),
+            )
+        values = state.digest.quantiles(list(self.quantiles))
+        keyed = {_format_quantile(q): v for q, v in zip(self.quantiles, values)}
+        return KeyedDoubleMetric(self.entity, self.name, self.instance, Success(keyed))
+
+    def to_failure_metric(self, exception: BaseException) -> Metric:
+        from deequ_tpu.core.exceptions import wrap_if_necessary
+        from deequ_tpu.core.maybe import Failure
+
+        return KeyedDoubleMetric(
+            self.entity, self.name, self.instance, Failure(wrap_if_necessary(exception))
+        )
+
+    def __repr__(self) -> str:
+        qs = ", ".join(_format_quantile(q) for q in self.quantiles)
+        return f"ApproxQuantiles({self.column},List({qs}),{self.relative_error})"
+
+
+def _format_quantile(q: float) -> str:
+    return repr(float(q))

@@ -1,0 +1,15 @@
+from deequ_tpu.checks.check import (
+    Check,
+    CheckLevel,
+    CheckResult,
+    CheckStatus,
+    CheckWithLastConstraintFilterable,
+)
+
+__all__ = [
+    "Check",
+    "CheckLevel",
+    "CheckResult",
+    "CheckStatus",
+    "CheckWithLastConstraintFilterable",
+]

@@ -1,0 +1,19 @@
+from deequ_tpu.constraints.constraint import (
+    AnalysisBasedConstraint,
+    Constraint,
+    ConstraintDecorator,
+    ConstraintResult,
+    ConstraintStatus,
+    NamedConstraint,
+)
+from deequ_tpu.constraints.constrainable_data_types import ConstrainableDataTypes
+
+__all__ = [
+    "AnalysisBasedConstraint",
+    "Constraint",
+    "ConstraintDecorator",
+    "ConstraintResult",
+    "ConstraintStatus",
+    "NamedConstraint",
+    "ConstrainableDataTypes",
+]

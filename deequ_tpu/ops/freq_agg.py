@@ -1,0 +1,67 @@
+"""Shared device aggregation over a frequencies table.
+
+One compiled program per grouping set computes every requested frequency
+aggregation (uniqueness, distinctness, entropy, ...) over the padded counts
+array — the analogue of the reference sharing `frequencies.agg(all fns)`
+(reference: runners/AnalysisRunner.scala:466-534, esp. :497-500).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deequ_tpu.ops import runtime
+from deequ_tpu.ops.fused import _pad_size, _to_f64
+
+if TYPE_CHECKING:
+    from deequ_tpu.analyzers.frequency import (
+        FrequenciesAndNumRows,
+        ScanShareableFrequencyBasedAnalyzer,
+    )
+
+_FREQ_CACHE: Dict[Any, Any] = {}
+
+# below this many groups the jit round-trip costs more than numpy
+_DEVICE_THRESHOLD = 1 << 16
+
+
+def _get_freq_fn(analyzers: Sequence["ScanShareableFrequencyBasedAnalyzer"]):
+    key = (tuple(repr(a) for a in analyzers), bool(jax.config.jax_enable_x64))
+    fn = _FREQ_CACHE.get(key)
+    if fn is None:
+
+        def fused(counts, num_rows):
+            return tuple(a.freq_reduce(counts, num_rows, jnp) for a in analyzers)
+
+        fn = jax.jit(fused)
+        _FREQ_CACHE[key] = fn
+    return fn
+
+
+def run_shared_freq_agg(
+    state: "FrequenciesAndNumRows",
+    analyzers: Sequence["ScanShareableFrequencyBasedAnalyzer"],
+) -> List[Any]:
+    """One fused aggregation pass -> one metric per analyzer (in order)."""
+    runtime.record_pass("freq-agg:" + ",".join(a.name for a in analyzers))
+    counts = state.counts.astype(np.float64)
+
+    if len(counts) >= _DEVICE_THRESHOLD:
+        dtype = runtime.compute_dtype()
+        padded = runtime.pad_to(counts.astype(dtype), _pad_size(len(counts), 1 << 62))
+        runtime.record_launch()
+        fn = _get_freq_fn(analyzers)
+        aggs = [
+            _to_f64(t)
+            for t in jax.device_get(fn(jnp.asarray(padded), dtype(state.num_rows)))
+        ]
+    else:
+        aggs = [a.freq_reduce(counts, float(state.num_rows), np) for a in analyzers]
+
+    return [
+        a.metric_from_freq_agg(agg, state) for a, agg in zip(analyzers, aggs)
+    ]

@@ -93,60 +93,83 @@ class FusedScanPass:
     def run(self, table: Table) -> List[AnalyzerRunResult]:
         # 1. collect input specs; an analyzer whose spec construction fails
         #    (e.g. unparseable predicate) fails alone, not the pass
-        runnable_idx: List[int] = []
+        device_idx: List[int] = []
+        host_idx: List[int] = []
         results: Dict[int, AnalyzerRunResult] = {}
         specs: Dict[str, Any] = {}
         for i, analyzer in enumerate(self.analyzers):
+            if getattr(analyzer, "host_reduced", False):
+                host_idx.append(i)
+                continue
             try:
                 analyzer_specs = analyzer.input_specs()
             except Exception as e:  # noqa: BLE001
                 results[i] = AnalyzerRunResult(analyzer, error=e)
                 continue
-            runnable_idx.append(i)
+            device_idx.append(i)
             for spec in analyzer_specs:
                 specs.setdefault(spec.key, spec)
 
-        if runnable_idx:
-            runnable = [self.analyzers[i] for i in runnable_idx]
+        if device_idx or host_idx:
+            device_analyzers = [self.analyzers[i] for i in device_idx]
+            host_analyzers = [self.analyzers[i] for i in host_idx]
             try:
-                aggs = self._run_pass(table, runnable, specs)
-                for i, analyzer, agg in zip(runnable_idx, runnable, aggs):
+                aggs, host_states = self._run_pass(
+                    table, device_analyzers, specs, host_analyzers
+                )
+                for i, analyzer, agg in zip(device_idx, device_analyzers, aggs):
                     results[i] = AnalyzerRunResult(
                         analyzer, state=analyzer.state_from_aggregates(agg)
                     )
+                for i, analyzer, state in zip(host_idx, host_analyzers, host_states):
+                    results[i] = AnalyzerRunResult(analyzer, state=state)
             except Exception as e:  # noqa: BLE001
                 # a runtime failure of the shared pass fails every analyzer in
                 # it (reference: AnalysisRunner.scala:310-313)
-                for i, analyzer in zip(runnable_idx, runnable):
-                    results[i] = AnalyzerRunResult(analyzer, error=e)
+                for i in device_idx + host_idx:
+                    results[i] = AnalyzerRunResult(self.analyzers[i], error=e)
 
         return [results[i] for i in range(len(self.analyzers))]
 
-    def _run_pass(self, table: Table, analyzers, specs) -> List[Any]:
-        fused = get_fused_fn(analyzers)
+    def _run_pass(self, table: Table, analyzers, specs, host_analyzers=()):
+        fused = get_fused_fn(analyzers) if analyzers else None
         dtype = runtime.compute_dtype()
-        runtime.record_pass("scan:" + ",".join(a.name for a in analyzers))
+        runtime.record_pass(
+            "scan:" + ",".join(a.name for a in list(analyzers) + list(host_analyzers))
+        )
 
         total: Optional[List[Any]] = None
+        host_states: List[Any] = [None] * len(host_analyzers)
         for batch in table.batches(self.batch_size):
-            padded = _pad_size(batch.num_rows, self.batch_size)
-            inputs: Dict[str, jnp.ndarray] = {}
-            for key, spec in specs.items():
-                arr = spec.build(batch)
-                arr = runtime.pad_to(np.asarray(arr), padded)
-                if arr.dtype == np.bool_ or np.issubdtype(arr.dtype, np.integer):
-                    inputs[key] = jnp.asarray(arr)
+            if fused is not None:
+                padded = _pad_size(batch.num_rows, self.batch_size)
+                inputs: Dict[str, jnp.ndarray] = {}
+                for key, spec in specs.items():
+                    arr = spec.build(batch)
+                    arr = runtime.pad_to(np.asarray(arr), padded)
+                    if arr.dtype == np.bool_ or np.issubdtype(arr.dtype, np.integer):
+                        inputs[key] = jnp.asarray(arr)
+                    else:
+                        inputs[key] = jnp.asarray(arr.astype(dtype))
+                runtime.record_launch()
+                # async dispatch: the device crunches this batch while the
+                # host runs the host-reduced analyzers below
+                device_out = fused(inputs)
+            for j, analyzer in enumerate(host_analyzers):
+                partial = analyzer.host_reduce(batch)
+                if partial is not None:
+                    host_states[j] = (
+                        partial
+                        if host_states[j] is None
+                        else host_states[j].merge(partial)
+                    )
+            if fused is not None:
+                batch_aggs = [_to_f64(t) for t in jax.device_get(device_out)]
+                if total is None:
+                    total = batch_aggs
                 else:
-                    inputs[key] = jnp.asarray(arr.astype(dtype))
-            runtime.record_launch()
-            batch_aggs = jax.device_get(fused(inputs))
-            batch_aggs = [_to_f64(t) for t in batch_aggs]
-            if total is None:
-                total = batch_aggs
-            else:
-                total = [
-                    a.merge_agg(t, b, np)
-                    for a, t, b in zip(analyzers, total, batch_aggs)
-                ]
-        assert total is not None  # batches() always yields
-        return total
+                    total = [
+                        a.merge_agg(t, b, np)
+                        for a, t, b in zip(analyzers, total, batch_aggs)
+                    ]
+        return (total if total is not None else []), host_states

@@ -1,15 +1,17 @@
 """Grouping-analyzer execution: one frequency computation per distinct
-grouping-column-set, shared by every analyzer over it.
+grouping-column-set, shared by every analyzer over it, plus one fused
+aggregation pass over the resulting counts.
 
 reference: runners/AnalysisRunner.scala:164-180 (grouping by column set),
 :249-277 (runGroupingAnalyzers), :466-534 (shared aggregation over the
-frequencies table). Until the full frequency sharing lands, analyzers run
-individually with per-analyzer failure capture.
+frequencies table). Job accounting matches the reference invariant:
+N analyzers on the same grouping columns cost 2 jobs (1 group-by + 1
+shared aggregation), not 2·N.
 """
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Dict, Optional, Sequence
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
 
 from deequ_tpu.core.metrics import Metric
 from deequ_tpu.data.table import Table
@@ -26,9 +28,63 @@ def run_grouping_analyzers(
     aggregate_with: Optional["StateLoader"] = None,
     save_states_with: Optional["StatePersister"] = None,
 ) -> AnalyzerContext:
+    from deequ_tpu.analyzers.frequency import (
+        FrequencyBasedAnalyzer,
+        ScanShareableFrequencyBasedAnalyzer,
+        compute_frequencies,
+    )
+    from deequ_tpu.ops.freq_agg import run_shared_freq_agg
+
     metrics: Dict[object, Metric] = {}
-    for analyzer in analyzers:
-        metrics[analyzer] = analyzer.calculate(
-            data, aggregate_with, save_states_with
-        )
+
+    frequency_based = [a for a in analyzers if isinstance(a, FrequencyBasedAnalyzer)]
+    other = [a for a in analyzers if not isinstance(a, FrequencyBasedAnalyzer)]
+    for analyzer in other:
+        metrics[analyzer] = analyzer.calculate(data, aggregate_with, save_states_with)
+
+    # group by sorted grouping-column set (reference: AnalysisRunner.scala:164-180)
+    groups: Dict[Tuple[str, ...], List["FrequencyBasedAnalyzer"]] = {}
+    for analyzer in frequency_based:
+        groups.setdefault(tuple(sorted(analyzer.grouping_columns())), []).append(analyzer)
+
+    for cols, group in groups.items():
+        try:
+            shared_state = compute_frequencies(data, list(cols))
+        except Exception as e:  # noqa: BLE001
+            for analyzer in group:
+                metrics[analyzer] = analyzer.to_failure_metric(e)
+            continue
+
+        if aggregate_with is not None or save_states_with is not None:
+            # per-analyzer state merge/persist takes priority over fusion
+            for analyzer in group:
+                try:
+                    metrics[analyzer] = analyzer.calculate_metric(
+                        shared_state, aggregate_with, save_states_with
+                    )
+                except Exception as e:  # noqa: BLE001
+                    metrics[analyzer] = analyzer.to_failure_metric(e)
+            continue
+
+        shareable = [
+            a for a in group if isinstance(a, ScanShareableFrequencyBasedAnalyzer)
+        ]
+        non_shareable = [
+            a for a in group if not isinstance(a, ScanShareableFrequencyBasedAnalyzer)
+        ]
+        if shareable:
+            try:
+                for analyzer, metric in zip(
+                    shareable, run_shared_freq_agg(shared_state, shareable)
+                ):
+                    metrics[analyzer] = metric
+            except Exception as e:  # noqa: BLE001
+                for analyzer in shareable:
+                    metrics[analyzer] = analyzer.to_failure_metric(e)
+        for analyzer in non_shareable:  # e.g. MutualInformation: extra pass
+            try:
+                metrics[analyzer] = analyzer.compute_metric_from(shared_state)
+            except Exception as e:  # noqa: BLE001
+                metrics[analyzer] = analyzer.to_failure_metric(e)
+
     return AnalyzerContext(metrics)

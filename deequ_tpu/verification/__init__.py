@@ -1,0 +1,4 @@
+from deequ_tpu.verification.suite import VerificationSuite
+from deequ_tpu.verification.result import VerificationResult
+
+__all__ = ["VerificationSuite", "VerificationResult"]

@@ -1,0 +1,124 @@
+"""Fluent builder for verification runs.
+
+reference: VerificationRunBuilder.scala:28-308 (incl. the repository
+variant's options and addAnomalyCheck).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence
+
+from deequ_tpu.analyzers.base import Analyzer
+from deequ_tpu.checks.check import Check, CheckLevel
+from deequ_tpu.verification.result import VerificationResult
+from deequ_tpu.verification.suite import VerificationSuite
+
+if TYPE_CHECKING:
+    from deequ_tpu.analyzers.state_provider import StateLoader, StatePersister
+    from deequ_tpu.data.table import Table
+    from deequ_tpu.repository.base import MetricsRepository, ResultKey
+
+
+@dataclass
+class AnomalyCheckConfig:
+    """reference: VerificationRunBuilder.scala:303."""
+
+    level: CheckLevel
+    description: str
+    with_tag_values: Optional[Dict[str, str]] = None
+    after_date: Optional[int] = None
+    before_date: Optional[int] = None
+
+
+class VerificationRunBuilder:
+    def __init__(self, data: "Table"):
+        self._data = data
+        self._checks: List[Check] = []
+        self._required_analyzers: List[Analyzer] = []
+        self._metrics_repository: Optional["MetricsRepository"] = None
+        self._reuse_key: Optional["ResultKey"] = None
+        self._fail_if_results_missing = False
+        self._save_key: Optional["ResultKey"] = None
+        self._aggregate_with: Optional["StateLoader"] = None
+        self._save_states_with: Optional["StatePersister"] = None
+
+    def add_check(self, check: Check) -> "VerificationRunBuilder":
+        self._checks.append(check)
+        return self
+
+    def add_checks(self, checks: Sequence[Check]) -> "VerificationRunBuilder":
+        self._checks.extend(checks)
+        return self
+
+    def add_required_analyzer(self, analyzer: Analyzer) -> "VerificationRunBuilder":
+        self._required_analyzers.append(analyzer)
+        return self
+
+    def add_required_analyzers(self, analyzers: Sequence[Analyzer]) -> "VerificationRunBuilder":
+        self._required_analyzers.extend(analyzers)
+        return self
+
+    def aggregate_with(self, loader: "StateLoader") -> "VerificationRunBuilder":
+        self._aggregate_with = loader
+        return self
+
+    def save_states_with(self, persister: "StatePersister") -> "VerificationRunBuilder":
+        self._save_states_with = persister
+        return self
+
+    def use_repository(self, repository: "MetricsRepository") -> "VerificationRunBuilder":
+        """reference: VerificationRunBuilder.scala:114-117 — unlocks the
+        repository-backed options below."""
+        self._metrics_repository = repository
+        return self
+
+    def reuse_existing_results_for_key(
+        self, key: "ResultKey", fail_if_results_missing: bool = False
+    ) -> "VerificationRunBuilder":
+        self._reuse_key = key
+        self._fail_if_results_missing = fail_if_results_missing
+        return self
+
+    def save_or_append_result(self, key: "ResultKey") -> "VerificationRunBuilder":
+        self._save_key = key
+        return self
+
+    def add_anomaly_check(
+        self,
+        anomaly_detection_strategy,
+        analyzer: Analyzer,
+        anomaly_check_config: Optional[AnomalyCheckConfig] = None,
+    ) -> "VerificationRunBuilder":
+        """reference: VerificationRunBuilder.scala:194-210."""
+        if self._metrics_repository is None:
+            raise ValueError(
+                "addAnomalyCheck requires a repository — call use_repository first"
+            )
+        config = anomaly_check_config or AnomalyCheckConfig(
+            CheckLevel.WARNING,
+            f"Anomaly check for {analyzer!r}",
+        )
+        check = Check(config.level, config.description).is_newest_point_non_anomalous(
+            self._metrics_repository,
+            anomaly_detection_strategy,
+            analyzer,
+            config.with_tag_values,
+            config.after_date,
+            config.before_date,
+        )
+        self._checks.append(check)
+        return self
+
+    def run(self) -> VerificationResult:
+        return VerificationSuite.do_verification_run(
+            self._data,
+            self._checks,
+            self._required_analyzers,
+            aggregate_with=self._aggregate_with,
+            save_states_with=self._save_states_with,
+            metrics_repository=self._metrics_repository,
+            reuse_existing_results_for_key=self._reuse_key,
+            fail_if_results_missing=self._fail_if_results_missing,
+            save_or_append_results_with_key=self._save_key,
+        )

@@ -1,0 +1,117 @@
+"""VerificationSuite: orchestrates a verification run.
+
+reference: VerificationSuite.scala:49-281. Collects required analyzers from
+checks, runs one (fused) analysis, evaluates checks, persists results.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence
+
+from deequ_tpu.analyzers.base import Analyzer
+from deequ_tpu.checks.check import Check, CheckResult, CheckStatus
+from deequ_tpu.runners.analysis_runner import AnalysisRunner
+from deequ_tpu.runners.context import AnalyzerContext
+from deequ_tpu.verification.result import VerificationResult
+
+if TYPE_CHECKING:
+    from deequ_tpu.analyzers.state_provider import StateLoader, StatePersister
+    from deequ_tpu.data.table import Table
+    from deequ_tpu.repository.base import MetricsRepository, ResultKey
+
+
+class VerificationSuite:
+    @staticmethod
+    def on_data(data: "Table"):
+        from deequ_tpu.verification.run_builder import VerificationRunBuilder
+
+        return VerificationRunBuilder(data)
+
+    # reference: VerificationSuite.scala:80-104 (deprecated run shortcut)
+    def run(
+        self,
+        data: "Table",
+        checks: Sequence[Check],
+        required_analyzers: Sequence[Analyzer] = (),
+    ) -> VerificationResult:
+        return self.do_verification_run(data, checks, required_analyzers)
+
+    @staticmethod
+    def do_verification_run(
+        data: "Table",
+        checks: Sequence[Check],
+        required_analyzers: Sequence[Analyzer] = (),
+        aggregate_with: Optional["StateLoader"] = None,
+        save_states_with: Optional["StatePersister"] = None,
+        metrics_repository: Optional["MetricsRepository"] = None,
+        reuse_existing_results_for_key: Optional["ResultKey"] = None,
+        fail_if_results_missing: bool = False,
+        save_or_append_results_with_key: Optional["ResultKey"] = None,
+    ) -> VerificationResult:
+        """reference: VerificationSuite.scala:107-144."""
+        analyzers: List[Analyzer] = list(required_analyzers)
+        for check in checks:
+            analyzers.extend(check.required_analyzers())
+
+        analysis_results = AnalysisRunner.do_analysis_run(
+            data,
+            analyzers,
+            aggregate_with=aggregate_with,
+            save_states_with=save_states_with,
+            metrics_repository=metrics_repository,
+            reuse_existing_results_for_key=reuse_existing_results_for_key,
+            fail_if_results_missing=fail_if_results_missing,
+            save_or_append_results_with_key=save_or_append_results_with_key,
+        )
+
+        return VerificationSuite.evaluate(checks, analysis_results)
+
+    @staticmethod
+    def run_on_aggregated_states(
+        schema_table: "Table",
+        checks: Sequence[Check],
+        state_loaders: Sequence["StateLoader"],
+        required_analyzers: Sequence[Analyzer] = (),
+        save_states_with: Optional["StatePersister"] = None,
+        metrics_repository: Optional["MetricsRepository"] = None,
+        save_or_append_results_with_key: Optional["ResultKey"] = None,
+    ) -> VerificationResult:
+        """reference: VerificationSuite.scala:208-229."""
+        analyzers: List[Analyzer] = list(required_analyzers)
+        for check in checks:
+            analyzers.extend(check.required_analyzers())
+
+        analysis_results = AnalysisRunner.run_on_aggregated_states(
+            schema_table,
+            analyzers,
+            state_loaders,
+            save_states_with=save_states_with,
+            metrics_repository=metrics_repository,
+            save_or_append_results_with_key=save_or_append_results_with_key,
+        )
+        return VerificationSuite.evaluate(checks, analysis_results)
+
+    @staticmethod
+    def is_check_applicable_to_data(check: Check, schema, num_records: int = 1000):
+        """Dry-run the check's analyzers on generated data matching the
+        schema (reference: VerificationSuite.scala:238-261)."""
+        from deequ_tpu.applicability.applicability import Applicability
+
+        return Applicability().is_applicable(check, schema, num_records)
+
+    @staticmethod
+    def evaluate(
+        checks: Sequence[Check], analysis_context: AnalyzerContext
+    ) -> VerificationResult:
+        """reference: VerificationSuite.scala:263-281 — overall status is
+        the max severity over check statuses."""
+        check_results: Dict[Check, CheckResult] = {
+            check: check.evaluate(analysis_context) for check in checks
+        }
+        if check_results:
+            status = max(
+                (r.status for r in check_results.values()), key=lambda s: s.severity
+            )
+        else:
+            status = CheckStatus.SUCCESS
+        return VerificationResult(status, check_results, dict(analysis_context.metric_map))
