@@ -291,9 +291,11 @@ class ScanShareableAnalyzer(Analyzer):
 
     host_reduced = False
 
-    def host_reduce(self, batch: "Table"):
-        """Host-side partial State for one (unpadded) batch; None = no
-        contribution. Only called when host_reduced is True."""
+    def host_prepare(self):
+        """Per-pass setup for a host-reduced analyzer: validate parameters
+        and return a `reduce(batch) -> Optional[State]` closure. Errors here
+        fail this analyzer alone (mirrors device spec isolation). Only
+        called when host_reduced is True."""
         raise NotImplementedError
 
     def input_specs(self) -> List[InputSpec]:

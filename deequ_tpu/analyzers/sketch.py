@@ -202,27 +202,31 @@ class _QuantileAnalyzerBase(ScanShareableAnalyzer):
     host_reduced = True
 
     def input_specs(self) -> List[InputSpec]:
-        return [
-            col_values_spec(self.column),
-            col_valid_spec(self.column),
-            where_spec(self.where) if getattr(self, "where", None) is not None else where_spec(None),
-        ]
+        return []
 
-    def host_reduce(self, batch: Table) -> Optional[State]:
-        col = batch.column(self.column)
-        values, valid = col.numeric_values()
-        mask = valid
+    def host_prepare(self) -> Callable[[Table], Optional[State]]:
+        """Per-pass setup: parse the filter once; a bad predicate fails this
+        analyzer alone (matching the device path's spec isolation)."""
         where = getattr(self, "where", None)
+        predicate = None
         if where is not None:
             from deequ_tpu.data.expr import Predicate
 
-            mask = mask & Predicate(where).eval_mask(batch)
-        selected = values[mask]
-        if len(selected) == 0:
-            return None
-        sketch = KLLSketch(k=k_for_error(self.relative_error), seed=_next_batch_seed())
-        sketch.update_batch(selected)
-        return ApproxQuantileState(sketch)
+            predicate = Predicate(where)
+        k = k_for_error(self.relative_error)
+
+        def reduce(batch: Table) -> Optional[State]:
+            col = batch.column(self.column)
+            values, valid = col.numeric_values()
+            mask = valid if predicate is None else valid & predicate.eval_mask(batch)
+            selected = values[mask]
+            if len(selected) == 0:
+                return None
+            sketch = KLLSketch(k=k, seed=_next_batch_seed())
+            sketch.update_batch(selected)
+            return ApproxQuantileState(sketch)
+
+        return reduce
 
 
 @dataclass(frozen=True)
@@ -261,7 +265,13 @@ class ApproxQuantile(_QuantileAnalyzerBase):
         )
 
     def __repr__(self) -> str:
-        return f"ApproxQuantile({self.column},{self.quantile},{self.relative_error})"
+        # `where` is our extension over the reference signature
+        # (reference: ApproxQuantile.scala:49 has no filter); render it only
+        # when set so the default matches the reference toString
+        base = f"ApproxQuantile({self.column},{self.quantile},{self.relative_error}"
+        if self.where is not None:
+            return base + f",{render_where(self.where)})"
+        return base + ")"
 
 
 @dataclass(frozen=True)

@@ -1,14 +1,22 @@
 """State checkpoint layer: load/persist analyzer states.
 
-reference: analyzers/StateProvider.scala:36-69 (traits + in-memory
-provider). The filesystem provider with binary per-analyzer formats is in
-deequ_tpu/repository (added with the persistence milestone).
+reference: analyzers/StateProvider.scala:36-295. The filesystem provider
+keeps the reference's binary layouts (big-endian, Java DataOutputStream
+conventions) per analyzer type so states interoperate where the underlying
+sketch is format-compatible; files are keyed by a hash of the analyzer's
+identity string like the reference's MurmurHash3(analyzer.toString)
+(StateProvider.scala:81-83).
 """
 
 from __future__ import annotations
 
+import hashlib
+import os
+import struct
 import threading
-from typing import TYPE_CHECKING, Dict, Optional
+from typing import TYPE_CHECKING, Dict, List, Optional
+
+import numpy as np
 
 from deequ_tpu.analyzers.states import State
 
@@ -45,3 +53,257 @@ class InMemoryStateProvider(StateLoader, StatePersister):
         with self._lock:
             keys = ", ".join(repr(k) for k in self._states)
         return f"InMemoryStateProvider({keys})"
+
+
+class FileSystemStateProvider(StateLoader, StatePersister):
+    """Binary per-analyzer state files
+    (reference: HdfsStateProvider, StateProvider.scala:72-295)."""
+
+    def __init__(
+        self,
+        location_prefix: str,
+        num_partitions_for_histogram: int = 10,
+        allow_overwrite: bool = False,
+    ):
+        self.location_prefix = location_prefix
+        self.num_partitions_for_histogram = num_partitions_for_histogram
+        self.allow_overwrite = allow_overwrite
+
+    def _identifier(self, analyzer: "Analyzer") -> str:
+        digest = hashlib.sha1(repr(analyzer).encode("utf-8")).hexdigest()[:16]
+        return digest
+
+    def _path(self, identifier: str, suffix: str = ".bin") -> str:
+        return f"{self.location_prefix}-{identifier}{suffix}"
+
+    # -- persist -------------------------------------------------------------
+
+    def persist(self, analyzer: "Analyzer", state: State) -> None:
+        from deequ_tpu.analyzers.frequency import FrequencyBasedAnalyzer
+        from deequ_tpu.analyzers.histogram import Histogram
+        from deequ_tpu.analyzers.scan import (
+            Completeness,
+            Compliance,
+            Correlation,
+            DataType,
+            Maximum,
+            Mean,
+            Minimum,
+            PatternMatch,
+            Size,
+            StandardDeviation,
+            Sum,
+        )
+        from deequ_tpu.analyzers.sketch import ApproxCountDistinct, ApproxQuantile, ApproxQuantiles
+        from deequ_tpu.analyzers import states as S
+
+        identifier = self._identifier(analyzer)
+
+        if isinstance(analyzer, Size):
+            self._write(identifier, struct.pack(">q", state.num_matches))
+        elif isinstance(analyzer, (Completeness, Compliance, PatternMatch)):
+            self._write(identifier, struct.pack(">qq", state.num_matches, state.count))
+        elif isinstance(analyzer, Sum):
+            self._write(identifier, struct.pack(">d", state.sum_value))
+        elif isinstance(analyzer, Mean):
+            self._write(identifier, struct.pack(">dq", state.total, state.count))
+        elif isinstance(analyzer, Minimum):
+            self._write(identifier, struct.pack(">d", state.min_value))
+        elif isinstance(analyzer, Maximum):
+            self._write(identifier, struct.pack(">d", state.max_value))
+        elif isinstance(analyzer, (FrequencyBasedAnalyzer, Histogram)):
+            self._persist_frequencies(identifier, state)
+        elif isinstance(analyzer, DataType):
+            payload = struct.pack(
+                ">qqqqq",
+                state.num_null,
+                state.num_fractional,
+                state.num_integral,
+                state.num_boolean,
+                state.num_string,
+            )
+            self._write(identifier, struct.pack(">i", len(payload)) + payload)
+        elif isinstance(analyzer, ApproxCountDistinct):
+            words = state.words()
+            payload = struct.pack(f">{len(words)}q", *[int(w) for w in words])
+            self._write(identifier, struct.pack(">i", len(payload)) + payload)
+        elif isinstance(analyzer, Correlation):
+            self._write(
+                identifier,
+                struct.pack(
+                    ">dddddd",
+                    state.n,
+                    state.x_avg,
+                    state.y_avg,
+                    state.ck,
+                    state.x_mk,
+                    state.y_mk,
+                ),
+            )
+        elif isinstance(analyzer, StandardDeviation):
+            self._write(identifier, struct.pack(">ddd", state.n, state.avg, state.m2))
+        elif isinstance(analyzer, (ApproxQuantile, ApproxQuantiles)):
+            self._write(identifier, _serialize_kll(state.digest))
+        else:
+            raise ValueError(f"Unable to persist state for analyzer {analyzer!r}.")
+
+    # -- load ----------------------------------------------------------------
+
+    def load(self, analyzer: "Analyzer") -> Optional[State]:
+        from deequ_tpu.analyzers.frequency import FrequencyBasedAnalyzer
+        from deequ_tpu.analyzers.histogram import Histogram
+        from deequ_tpu.analyzers.scan import (
+            Completeness,
+            Compliance,
+            Correlation,
+            DataType,
+            Maximum,
+            Mean,
+            Minimum,
+            PatternMatch,
+            Size,
+            StandardDeviation,
+            Sum,
+        )
+        from deequ_tpu.analyzers.sketch import (
+            ApproxCountDistinct,
+            ApproxCountDistinctState,
+            ApproxQuantile,
+            ApproxQuantiles,
+            ApproxQuantileState,
+        )
+        from deequ_tpu.analyzers import states as S
+        from deequ_tpu.ops.sketches import hll as hll_mod
+
+        identifier = self._identifier(analyzer)
+        if isinstance(analyzer, (FrequencyBasedAnalyzer, Histogram)):
+            return self._load_frequencies(identifier)
+        data = self._read(identifier)
+        if data is None:
+            return None
+
+        if isinstance(analyzer, Size):
+            return S.NumMatches(struct.unpack(">q", data)[0])
+        if isinstance(analyzer, (Completeness, Compliance, PatternMatch)):
+            matches, count = struct.unpack(">qq", data)
+            return S.NumMatchesAndCount(matches, count)
+        if isinstance(analyzer, Sum):
+            return S.SumState(struct.unpack(">d", data)[0])
+        if isinstance(analyzer, Mean):
+            total, count = struct.unpack(">dq", data)
+            return S.MeanState(total, count)
+        if isinstance(analyzer, Minimum):
+            return S.MinState(struct.unpack(">d", data)[0])
+        if isinstance(analyzer, Maximum):
+            return S.MaxState(struct.unpack(">d", data)[0])
+        if isinstance(analyzer, DataType):
+            (length,) = struct.unpack(">i", data[:4])
+            values = struct.unpack(">qqqqq", data[4 : 4 + length])
+            return S.DataTypeHistogram(*values)
+        if isinstance(analyzer, ApproxCountDistinct):
+            (length,) = struct.unpack(">i", data[:4])
+            words = np.array(
+                struct.unpack(f">{length // 8}q", data[4 : 4 + length]), dtype=np.int64
+            )
+            return ApproxCountDistinctState(hll_mod.unpack_words(words))
+        if isinstance(analyzer, Correlation):
+            return S.CorrelationState(*struct.unpack(">dddddd", data))
+        if isinstance(analyzer, StandardDeviation):
+            return S.StandardDeviationState(*struct.unpack(">ddd", data))
+        if isinstance(analyzer, (ApproxQuantile, ApproxQuantiles)):
+            return ApproxQuantileState(_deserialize_kll(data))
+        raise ValueError(f"Unable to load state for analyzer {analyzer!r}.")
+
+    # -- io ------------------------------------------------------------------
+
+    def _write(self, identifier: str, payload: bytes) -> None:
+        path = self._path(identifier)
+        if os.path.exists(path) and not self.allow_overwrite:
+            raise FileExistsError(f"File {path} already exists and overwrite disabled")
+        directory = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(directory, exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(payload)
+
+    def _read(self, identifier: str) -> Optional[bytes]:
+        path = self._path(identifier)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def _persist_frequencies(self, identifier: str, state) -> None:
+        """Frequencies as Parquet + numRows binary
+        (reference: StateProvider.scala:211-223)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from deequ_tpu.analyzers.base import COUNT_COL
+
+        pqt_path = self._path(identifier, "-frequencies.pqt")
+        if os.path.exists(pqt_path) and not self.allow_overwrite:
+            raise FileExistsError(
+                f"File {pqt_path} already exists and overwrite disabled"
+            )
+        directory = os.path.dirname(os.path.abspath(pqt_path)) or "."
+        os.makedirs(directory, exist_ok=True)
+
+        columns = {
+            name: [key[i] for key in state.keys]
+            for i, name in enumerate(state.columns)
+        }
+        columns[COUNT_COL] = [int(c) for c in state.counts]
+        pq.write_table(
+            pa.table(columns), self._path(identifier, "-frequencies.pqt")
+        )
+        with open(self._path(identifier, "-num_rows.bin"), "wb") as f:
+            f.write(struct.pack(">q", state.num_rows))
+        with open(self._path(identifier, "-columns.txt"), "w", encoding="utf-8") as f:
+            f.write("\n".join(state.columns))
+
+    def _load_frequencies(self, identifier: str):
+        import pyarrow.parquet as pq
+
+        from deequ_tpu.analyzers.base import COUNT_COL
+        from deequ_tpu.analyzers.frequency import FrequenciesAndNumRows
+
+        pqt_path = self._path(identifier, "-frequencies.pqt")
+        if not os.path.exists(pqt_path):
+            return None
+        table = pq.read_table(pqt_path)
+        with open(self._path(identifier, "-columns.txt"), encoding="utf-8") as f:
+            columns = [line for line in f.read().split("\n") if line]
+        with open(self._path(identifier, "-num_rows.bin"), "rb") as f:
+            (num_rows,) = struct.unpack(">q", f.read())
+        counts = np.asarray(table.column(COUNT_COL).to_pylist(), dtype=np.int64)
+        key_columns = [table.column(c).to_pylist() for c in columns]
+        keys = [tuple(col[i] for col in key_columns) for i in range(len(counts))]
+        return FrequenciesAndNumRows(columns, keys, counts, int(num_rows))
+
+
+def _serialize_kll(digest) -> bytes:
+    """Our own digest layout (KLL, not the reference's GK digest — the
+    sketch algorithms differ; see BASELINE.md parity notes)."""
+    k, n, levels = digest.to_arrays()
+    parts = [struct.pack(">iqi", k, n, len(levels))]
+    for level in levels:
+        parts.append(struct.pack(">i", len(level)))
+        parts.append(np.asarray(level, dtype=">f8").tobytes())
+    return b"".join(parts)
+
+
+def _deserialize_kll(data: bytes):
+    from deequ_tpu.ops.sketches.kll import KLLSketch
+
+    k, n, depth = struct.unpack(">iqi", data[:16])
+    offset = 16
+    levels = []
+    for _ in range(depth):
+        (length,) = struct.unpack(">i", data[offset : offset + 4])
+        offset += 4
+        level = np.frombuffer(data[offset : offset + 8 * length], dtype=">f8").astype(
+            np.float64
+        )
+        offset += 8 * length
+        levels.append(level)
+    return KLLSketch.from_arrays(k, n, levels)

@@ -249,6 +249,8 @@ def unique_value_ratio_constraint(
 ) -> Constraint:
     ratio = UniqueValueRatio(list(columns))
     constraint = AnalysisBasedConstraint(ratio, assertion, hint=hint)
+    # missing ")" is deliberate: mirrors the reference's own toString typo
+    # (reference: constraints/Constraint.scala:254) for output parity
     return NamedConstraint(constraint, f"UniqueValueRatioConstraint({ratio!r}")
 
 

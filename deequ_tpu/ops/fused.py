@@ -95,11 +95,18 @@ class FusedScanPass:
         #    (e.g. unparseable predicate) fails alone, not the pass
         device_idx: List[int] = []
         host_idx: List[int] = []
+        host_reducers: List[Any] = []
         results: Dict[int, AnalyzerRunResult] = {}
         specs: Dict[str, Any] = {}
         for i, analyzer in enumerate(self.analyzers):
             if getattr(analyzer, "host_reduced", False):
+                try:
+                    reducer = analyzer.host_prepare()
+                except Exception as e:  # noqa: BLE001
+                    results[i] = AnalyzerRunResult(analyzer, error=e)
+                    continue
                 host_idx.append(i)
+                host_reducers.append(reducer)
                 continue
             try:
                 analyzer_specs = analyzer.input_specs()
@@ -115,7 +122,7 @@ class FusedScanPass:
             host_analyzers = [self.analyzers[i] for i in host_idx]
             try:
                 aggs, host_states = self._run_pass(
-                    table, device_analyzers, specs, host_analyzers
+                    table, device_analyzers, specs, host_analyzers, host_reducers
                 )
                 for i, analyzer, agg in zip(device_idx, device_analyzers, aggs):
                     results[i] = AnalyzerRunResult(
@@ -131,7 +138,7 @@ class FusedScanPass:
 
         return [results[i] for i in range(len(self.analyzers))]
 
-    def _run_pass(self, table: Table, analyzers, specs, host_analyzers=()):
+    def _run_pass(self, table: Table, analyzers, specs, host_analyzers=(), host_reducers=()):
         fused = get_fused_fn(analyzers) if analyzers else None
         dtype = runtime.compute_dtype()
         runtime.record_pass(
@@ -155,8 +162,8 @@ class FusedScanPass:
                 # async dispatch: the device crunches this batch while the
                 # host runs the host-reduced analyzers below
                 device_out = fused(inputs)
-            for j, analyzer in enumerate(host_analyzers):
-                partial = analyzer.host_reduce(batch)
+            for j, reducer in enumerate(host_reducers):
+                partial = reducer(batch)
                 if partial is not None:
                     host_states[j] = (
                         partial

@@ -1,0 +1,17 @@
+from deequ_tpu.repository.base import (
+    AnalysisResult,
+    MetricsRepository,
+    MetricsRepositoryMultipleResultsLoader,
+    ResultKey,
+)
+from deequ_tpu.repository.memory import InMemoryMetricsRepository
+from deequ_tpu.repository.fs import FileSystemMetricsRepository
+
+__all__ = [
+    "AnalysisResult",
+    "MetricsRepository",
+    "MetricsRepositoryMultipleResultsLoader",
+    "ResultKey",
+    "InMemoryMetricsRepository",
+    "FileSystemMetricsRepository",
+]

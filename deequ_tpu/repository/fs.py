@@ -1,0 +1,86 @@
+"""Filesystem metrics repository: whole history in a single JSON file with
+atomic tmp+rename writes.
+
+reference: repository/fs/FileSystemMetricsRepository.scala:32-226.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import List, Optional
+
+from deequ_tpu.repository.base import (
+    AnalysisResult,
+    MetricsRepository,
+    MetricsRepositoryMultipleResultsLoader,
+    ResultKey,
+)
+from deequ_tpu.repository.serde import (
+    deserialize_analysis_results,
+    serialize_analysis_results,
+)
+from deequ_tpu.runners.context import AnalyzerContext
+
+
+class FileSystemMetricsRepository(MetricsRepository):
+    def __init__(self, path: str):
+        self.path = path
+
+    def save(self, result_key: ResultKey, analyzer_context: AnalyzerContext) -> None:
+        successful = AnalyzerContext(
+            {
+                analyzer: metric
+                for analyzer, metric in analyzer_context.metric_map.items()
+                if metric.value.is_success
+            }
+        )
+        history = self._load_all()
+        history = [r for r in history if r.result_key != result_key]
+        history.append(AnalysisResult(result_key, successful))
+        self._write_atomically(serialize_analysis_results(history))
+
+    def load_by_key(self, result_key: ResultKey) -> Optional[AnalyzerContext]:
+        for result in self._load_all():
+            if result.result_key == result_key:
+                return result.analyzer_context
+        return None
+
+    def load(self) -> "FileSystemMetricsRepositoryMultipleResultsLoader":
+        return FileSystemMetricsRepositoryMultipleResultsLoader(self)
+
+    # -- internals -----------------------------------------------------------
+
+    def _load_all(self) -> List[AnalysisResult]:
+        if not os.path.exists(self.path):
+            return []
+        with open(self.path, "r", encoding="utf-8") as f:
+            payload = f.read()
+        if not payload.strip():
+            return []
+        return deserialize_analysis_results(payload)
+
+    def _write_atomically(self, payload: str) -> None:
+        """tmp file + rename (reference: FileSystemMetricsRepository.scala:167-195)."""
+        directory = os.path.dirname(os.path.abspath(self.path)) or "."
+        os.makedirs(directory, exist_ok=True)
+        fd, tmp_path = tempfile.mkstemp(dir=directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                f.write(payload)
+            os.replace(tmp_path, self.path)
+        except BaseException:
+            if os.path.exists(tmp_path):
+                os.unlink(tmp_path)
+            raise
+
+
+class FileSystemMetricsRepositoryMultipleResultsLoader(
+    MetricsRepositoryMultipleResultsLoader
+):
+    def __init__(self, repository: FileSystemMetricsRepository):
+        super().__init__()
+        self._repository = repository
+
+    def get(self) -> List[AnalysisResult]:
+        return self._apply_filters(self._repository._load_all())

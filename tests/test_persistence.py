@@ -1,0 +1,359 @@
+"""Repository, serde, and state-provider tests (mirrors reference
+repository tests, AnalysisResultSerdeTest, StateProviderTest, and the
+incremental/partitioned-state integration tests)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    ApproxQuantile,
+    ApproxQuantiles,
+    Completeness,
+    Compliance,
+    Correlation,
+    CountDistinct,
+    DataType,
+    Distinctness,
+    Entropy,
+    Histogram,
+    Maximum,
+    Mean,
+    Minimum,
+    MutualInformation,
+    PatternMatch,
+    Size,
+    StandardDeviation,
+    Sum,
+    UniqueValueRatio,
+    Uniqueness,
+)
+from deequ_tpu.analyzers.state_provider import (
+    FileSystemStateProvider,
+    InMemoryStateProvider,
+)
+from deequ_tpu.data.table import Table
+from deequ_tpu.ops import runtime
+from deequ_tpu.repository import (
+    FileSystemMetricsRepository,
+    InMemoryMetricsRepository,
+    ResultKey,
+)
+from deequ_tpu.repository.serde import (
+    deserialize_analysis_results,
+    deserialize_analyzer,
+    serialize_analysis_results,
+    serialize_analyzer,
+)
+from deequ_tpu.runners import AnalysisRunner
+
+from fixtures import get_df_missing, get_df_with_numeric_values, get_df_full
+
+ALL_SERIALIZABLE_ANALYZERS = [
+    Size(),
+    Size(where="x > 2"),
+    Completeness("col"),
+    Completeness("col", where="x > 2"),
+    Compliance("rule", "att1 > 0"),
+    PatternMatch("col", r"\d+"),
+    Sum("col"),
+    Mean("col"),
+    Minimum("col"),
+    Maximum("col"),
+    CountDistinct(["a", "b"]),
+    Distinctness(["a"]),
+    Entropy("col"),
+    MutualInformation(["a", "b"]),
+    UniqueValueRatio(["a"]),
+    Uniqueness(["a", "b"]),
+    Histogram("col"),
+    Histogram("col", max_detail_bins=10),
+    DataType("col"),
+    ApproxCountDistinct("col"),
+    Correlation("a", "b"),
+    StandardDeviation("col"),
+    ApproxQuantile("col", 0.5),
+    ApproxQuantiles("col", [0.25, 0.5, 0.75]),
+]
+
+
+class TestAnalyzerSerde:
+    def test_roundtrip_every_analyzer(self):
+        for analyzer in ALL_SERIALIZABLE_ANALYZERS:
+            data = serialize_analyzer(analyzer)
+            restored = deserialize_analyzer(json.loads(json.dumps(data)))
+            assert restored == analyzer, repr(analyzer)
+
+    def test_histogram_with_udf_rejected(self):
+        with pytest.raises(ValueError, match="Unable to serialize"):
+            serialize_analyzer(Histogram("col", binning_udf=lambda v: v))
+
+    def test_reference_compatible_fields(self):
+        data = serialize_analyzer(Completeness("att1", where="x > 1"))
+        assert data == {
+            "analyzerName": "Completeness",
+            "column": "att1",
+            "where": "x > 1",
+        }
+
+
+class TestAnalysisResultSerde:
+    def make_context(self):
+        df = get_df_with_numeric_values()
+        return (
+            AnalysisRunner.on_data(df)
+            .add_analyzers(
+                [
+                    Size(),
+                    Mean("att1"),
+                    Uniqueness(["att1"]),
+                    DataType("att1"),
+                    ApproxQuantiles("att1", [0.5]),
+                ]
+            )
+            .run()
+        )
+
+    def test_roundtrip(self):
+        from deequ_tpu.repository.base import AnalysisResult
+
+        context = self.make_context()
+        key = ResultKey(12345, {"env": "test"})
+        payload = serialize_analysis_results([AnalysisResult(key, context)])
+        restored = deserialize_analysis_results(payload)
+        assert len(restored) == 1
+        assert restored[0].result_key == key
+        restored_map = restored[0].analyzer_context.metric_map
+        assert restored_map[Size()].value.get() == 6.0
+        assert restored_map[Mean("att1")].value.get() == 3.5
+        assert restored_map[Uniqueness(["att1"])].value.get() == 1.0
+        hist = restored_map[DataType("att1")].value.get()
+        assert hist["Integral"].ratio == 1.0
+        keyed = restored_map[ApproxQuantiles("att1", [0.5])].value.get()
+        assert keyed["0.5"] in (3.0, 4.0)
+
+
+class TestRepositories:
+    @pytest.mark.parametrize("repo_kind", ["memory", "fs"])
+    def test_save_and_load_by_key(self, repo_kind, tmp_path):
+        repo = (
+            InMemoryMetricsRepository()
+            if repo_kind == "memory"
+            else FileSystemMetricsRepository(str(tmp_path / "metrics.json"))
+        )
+        df = get_df_with_numeric_values()
+        key = ResultKey(1000, {"env": "test"})
+        (
+            AnalysisRunner.on_data(df)
+            .add_analyzers([Size(), Mean("att1"), Completeness("nope")])
+            .use_repository(repo)
+            .save_or_append_result(key)
+            .run()
+        )
+        loaded = repo.load_by_key(key)
+        assert loaded is not None
+        assert loaded.metric_map[Size()].value.get() == 6.0
+        # failed metric filtered on save
+        assert Completeness("nope") not in loaded.metric_map
+
+    @pytest.mark.parametrize("repo_kind", ["memory", "fs"])
+    def test_loader_queries(self, repo_kind, tmp_path):
+        repo = (
+            InMemoryMetricsRepository()
+            if repo_kind == "memory"
+            else FileSystemMetricsRepository(str(tmp_path / "metrics.json"))
+        )
+        df = get_df_with_numeric_values()
+        for date, env in [(100, "dev"), (200, "prod"), (300, "prod")]:
+            (
+                AnalysisRunner.on_data(df)
+                .add_analyzers([Size(), Mean("att1")])
+                .use_repository(repo)
+                .save_or_append_result(ResultKey(date, {"env": env}))
+                .run()
+            )
+        assert len(repo.load().get()) == 3
+        assert len(repo.load().with_tag_values({"env": "prod"}).get()) == 2
+        assert len(repo.load().after(150).get()) == 2
+        assert len(repo.load().before(150).get()) == 1
+        assert len(repo.load().after(150).before(250).get()) == 1
+        only_size = repo.load().for_analyzers([Size()]).get()
+        assert all(
+            set(r.analyzer_context.metric_map) == {Size()} for r in only_size
+        )
+
+    def test_repository_reuse_short_circuits(self):
+        repo = InMemoryMetricsRepository()
+        df = get_df_with_numeric_values()
+        key = ResultKey(1, {})
+        (
+            AnalysisRunner.on_data(df)
+            .add_analyzer(Distinctness(["att1"]))
+            .use_repository(repo)
+            .save_or_append_result(key)
+            .run()
+        )
+        # cached distinctness + 2 new analyzers => 1 scan pass only
+        with runtime.monitored() as stats:
+            context = (
+                AnalysisRunner.on_data(df)
+                .add_analyzers([Distinctness(["att1"]), Size(), Mean("att1")])
+                .use_repository(repo)
+                .reuse_existing_results_for_key(key)
+                .run()
+            )
+        assert stats.jobs == 1
+        assert len(context.metric_map) == 3
+
+    def test_fail_if_results_missing(self):
+        repo = InMemoryMetricsRepository()
+        df = get_df_with_numeric_values()
+        with pytest.raises(RuntimeError, match="Could not find all necessary results"):
+            (
+                AnalysisRunner.on_data(df)
+                .add_analyzer(Size())
+                .use_repository(repo)
+                .reuse_existing_results_for_key(ResultKey(9, {}), fail_if_results_missing=True)
+                .run()
+            )
+
+    def test_loader_json_union_with_tags(self):
+        repo = InMemoryMetricsRepository()
+        df = get_df_with_numeric_values()
+        (
+            AnalysisRunner.on_data(df)
+            .add_analyzer(Size())
+            .use_repository(repo)
+            .save_or_append_result(ResultKey(1, {"region": "eu"}))
+            .run()
+        )
+        rows = json.loads(repo.load().get_success_metrics_as_json())
+        assert rows[0]["region"] == "eu"
+        assert rows[0]["dataset_date"] == 1
+
+    def test_fs_repository_overwrites_same_key(self, tmp_path):
+        path = str(tmp_path / "m.json")
+        repo = FileSystemMetricsRepository(path)
+        df = get_df_with_numeric_values()
+        key = ResultKey(5, {})
+        for _ in range(2):
+            (
+                AnalysisRunner.on_data(df)
+                .add_analyzer(Size())
+                .use_repository(repo)
+                .save_or_append_result(key)
+                .run()
+            )
+        assert len(repo.load().get()) == 1
+
+
+class TestStateProviders:
+    def states_to_test(self, df):
+        return [
+            Size(),
+            Completeness("att1"),
+            Compliance("r", "att1 > 3"),
+            Sum("att1"),
+            Mean("att1"),
+            Minimum("att1"),
+            Maximum("att1"),
+            StandardDeviation("att1"),
+            Correlation("att1", "att2"),
+            DataType("item"),
+            ApproxCountDistinct("att1"),
+            ApproxQuantile("att1", 0.5),
+            Uniqueness(["att1"]),
+        ]
+
+    @pytest.mark.parametrize("provider_kind", ["memory", "fs"])
+    def test_roundtrip_states(self, provider_kind, tmp_path):
+        df = get_df_with_numeric_values()
+        provider = (
+            InMemoryStateProvider()
+            if provider_kind == "memory"
+            else FileSystemStateProvider(str(tmp_path / "states"), allow_overwrite=True)
+        )
+        for analyzer in self.states_to_test(df):
+            state = analyzer.compute_state_from(df)
+            assert state is not None, repr(analyzer)
+            provider.persist(analyzer, state)
+            loaded = provider.load(analyzer)
+            metric_a = analyzer.compute_metric_from(state)
+            metric_b = analyzer.compute_metric_from(loaded)
+            va, vb = metric_a.value.get(), metric_b.value.get()
+            if isinstance(va, float):
+                assert vb == pytest.approx(va, rel=1e-12), repr(analyzer)
+            else:
+                assert va == vb, repr(analyzer)
+
+
+class TestIncrementalStates:
+    """The 'multi-node without cluster' contract: metrics from merged
+    per-partition states == single-pass metrics (reference:
+    StateAggregationIntegrationTest.scala:31-188)."""
+
+    def test_partitioned_equals_whole(self):
+        df = get_df_missing()
+        partitions = [df.slice(0, 4), df.slice(4, 8), df.slice(8, 12)]
+        analyzers = [
+            Size(),
+            Completeness("att1"),
+            Mean("item2") if False else Completeness("att2"),
+            Uniqueness(["att1"]),
+            CountDistinct(["att1"]),
+        ]
+        providers = []
+        for part in partitions:
+            provider = InMemoryStateProvider()
+            AnalysisRunner.do_analysis_run(
+                part, analyzers, save_states_with=provider
+            )
+            providers.append(provider)
+
+        merged_context = AnalysisRunner.run_on_aggregated_states(
+            df.slice(0, 0), analyzers, providers
+        )
+        direct_context = AnalysisRunner.do_analysis_run(df, analyzers)
+
+        for analyzer in analyzers:
+            merged = merged_context.metric_map[analyzer].value
+            direct = direct_context.metric_map[analyzer].value
+            assert merged.is_success and direct.is_success, repr(analyzer)
+            assert merged.get() == pytest.approx(direct.get()), repr(analyzer)
+
+    def test_incremental_update(self):
+        df = get_df_with_numeric_values()
+        old, new = df.slice(0, 4), df.slice(4, 6)
+        provider = InMemoryStateProvider()
+        analyzers = [Size(), Mean("att1"), StandardDeviation("att1")]
+        AnalysisRunner.do_analysis_run(old, analyzers, save_states_with=provider)
+        # incremental: aggregate new data with the stored state
+        context = AnalysisRunner.do_analysis_run(
+            new, analyzers, aggregate_with=provider
+        )
+        direct = AnalysisRunner.do_analysis_run(df, analyzers)
+        for analyzer in analyzers:
+            assert context.metric_map[analyzer].value.get() == pytest.approx(
+                direct.metric_map[analyzer].value.get()
+            ), repr(analyzer)
+
+    def test_verification_suite_on_aggregated_states(self):
+        from deequ_tpu import Check, CheckLevel, CheckStatus, VerificationSuite
+
+        df = get_df_missing()
+        parts = [df.slice(0, 6), df.slice(6, 12)]
+        providers = []
+        check = Check(CheckLevel.ERROR, "agg").has_size(lambda s: s == 12).has_completeness(
+            "att1", lambda v: v == 0.5
+        )
+        analyzers = list(check.required_analyzers())
+        for part in parts:
+            provider = InMemoryStateProvider()
+            AnalysisRunner.do_analysis_run(part, analyzers, save_states_with=provider)
+            providers.append(provider)
+        result = VerificationSuite.run_on_aggregated_states(
+            df.slice(0, 0), [check], providers
+        )
+        assert result.status == CheckStatus.SUCCESS
