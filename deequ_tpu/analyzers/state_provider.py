@@ -59,14 +59,8 @@ class FileSystemStateProvider(StateLoader, StatePersister):
     """Binary per-analyzer state files
     (reference: HdfsStateProvider, StateProvider.scala:72-295)."""
 
-    def __init__(
-        self,
-        location_prefix: str,
-        num_partitions_for_histogram: int = 10,
-        allow_overwrite: bool = False,
-    ):
+    def __init__(self, location_prefix: str, allow_overwrite: bool = False):
         self.location_prefix = location_prefix
-        self.num_partitions_for_histogram = num_partitions_for_histogram
         self.allow_overwrite = allow_overwrite
 
     def _identifier(self, analyzer: "Analyzer") -> str:
@@ -95,7 +89,6 @@ class FileSystemStateProvider(StateLoader, StatePersister):
             Sum,
         )
         from deequ_tpu.analyzers.sketch import ApproxCountDistinct, ApproxQuantile, ApproxQuantiles
-        from deequ_tpu.analyzers import states as S
 
         identifier = self._identifier(analyzer)
 
@@ -240,12 +233,17 @@ class FileSystemStateProvider(StateLoader, StatePersister):
 
         from deequ_tpu.analyzers.base import COUNT_COL
 
-        pqt_path = self._path(identifier, "-frequencies.pqt")
-        if os.path.exists(pqt_path) and not self.allow_overwrite:
-            raise FileExistsError(
-                f"File {pqt_path} already exists and overwrite disabled"
-            )
-        directory = os.path.dirname(os.path.abspath(pqt_path)) or "."
+        paths = {
+            suffix: self._path(identifier, suffix)
+            for suffix in ("-frequencies.pqt", "-num_rows.bin", "-columns.txt")
+        }
+        if not self.allow_overwrite:
+            for path in paths.values():
+                if os.path.exists(path):
+                    raise FileExistsError(
+                        f"File {path} already exists and overwrite disabled"
+                    )
+        directory = os.path.dirname(os.path.abspath(paths["-frequencies.pqt"])) or "."
         os.makedirs(directory, exist_ok=True)
 
         columns = {
@@ -253,13 +251,16 @@ class FileSystemStateProvider(StateLoader, StatePersister):
             for i, name in enumerate(state.columns)
         }
         columns[COUNT_COL] = [int(c) for c in state.counts]
-        pq.write_table(
-            pa.table(columns), self._path(identifier, "-frequencies.pqt")
-        )
-        with open(self._path(identifier, "-num_rows.bin"), "wb") as f:
+        # write siblings first, parquet last via tmp+rename: load() keys on
+        # the .pqt, so a crash mid-persist leaves a state that reads as
+        # absent, never corrupt
+        with open(paths["-num_rows.bin"], "wb") as f:
             f.write(struct.pack(">q", state.num_rows))
-        with open(self._path(identifier, "-columns.txt"), "w", encoding="utf-8") as f:
+        with open(paths["-columns.txt"], "w", encoding="utf-8") as f:
             f.write("\n".join(state.columns))
+        tmp = paths["-frequencies.pqt"] + ".tmp"
+        pq.write_table(pa.table(columns), tmp)
+        os.replace(tmp, paths["-frequencies.pqt"])
 
     def _load_frequencies(self, identifier: str):
         import pyarrow.parquet as pq

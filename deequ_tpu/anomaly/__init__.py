@@ -1,0 +1,28 @@
+from deequ_tpu.anomaly.base import (
+    Anomaly,
+    AnomalyDetectionStrategy,
+    DetectionResult,
+)
+from deequ_tpu.anomaly.detector import AnomalyDetector, DataPoint
+from deequ_tpu.anomaly.strategies import (
+    BatchNormalStrategy,
+    OnlineNormalStrategy,
+    RateOfChangeStrategy,
+    SimpleThresholdStrategy,
+)
+from deequ_tpu.anomaly.holt_winters import HoltWinters, MetricInterval, SeriesSeasonality
+
+__all__ = [
+    "Anomaly",
+    "AnomalyDetectionStrategy",
+    "DetectionResult",
+    "AnomalyDetector",
+    "DataPoint",
+    "SimpleThresholdStrategy",
+    "RateOfChangeStrategy",
+    "OnlineNormalStrategy",
+    "BatchNormalStrategy",
+    "HoltWinters",
+    "MetricInterval",
+    "SeriesSeasonality",
+]

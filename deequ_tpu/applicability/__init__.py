@@ -1,0 +1,13 @@
+from deequ_tpu.applicability.applicability import (
+    Applicability,
+    AnalyzersApplicability,
+    CheckApplicability,
+    generate_random_data,
+)
+
+__all__ = [
+    "Applicability",
+    "AnalyzersApplicability",
+    "CheckApplicability",
+    "generate_random_data",
+]

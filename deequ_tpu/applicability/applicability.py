@@ -1,0 +1,130 @@
+"""Applicability checker: dry-run constraints/analyzers on generated random
+data matching a schema.
+
+reference: analyzers/applicability/Applicability.scala:40-273 — 1000 rows,
+~1% nulls for nullable fields, typed random generators. This doubles as the
+framework's schema-level fake backend.
+"""
+
+from __future__ import annotations
+
+import string
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deequ_tpu.checks.check import Check
+from deequ_tpu.constraints.constraint import (
+    AnalysisBasedConstraint,
+    Constraint,
+    ConstraintDecorator,
+)
+from deequ_tpu.data.table import Column, ColumnType, Table
+
+
+@dataclass
+class SchemaField:
+    name: str
+    ctype: ColumnType
+    nullable: bool = True
+    precision: int = 10
+    scale: int = 2
+
+
+@dataclass
+class CheckApplicability:
+    is_applicable: bool
+    failures: List[Tuple[str, BaseException]]
+    constraint_applicabilities: Dict[Constraint, bool]
+
+
+@dataclass
+class AnalyzersApplicability:
+    is_applicable: bool
+    failures: List[Tuple[str, BaseException]]
+
+
+def generate_random_data(
+    schema: Sequence[SchemaField], num_records: int = 1000, seed: Optional[int] = None
+) -> Table:
+    """reference: Applicability.scala:46-155 — ~1% nulls when nullable."""
+    rng = np.random.default_rng(seed)
+    columns = []
+    for fld in schema:
+        null_mask = (
+            rng.random(num_records) < 0.01
+            if fld.nullable
+            else np.zeros(num_records, dtype=bool)
+        )
+        valid = ~null_mask
+        if fld.ctype == ColumnType.BOOLEAN:
+            values = rng.random(num_records) > 0.5
+        elif fld.ctype == ColumnType.LONG:
+            values = rng.integers(-(2**31), 2**31, num_records, dtype=np.int64)
+        elif fld.ctype == ColumnType.DOUBLE:
+            values = rng.random(num_records)
+        elif fld.ctype == ColumnType.DECIMAL:
+            digits = fld.precision - fld.scale
+            whole = rng.integers(10 ** (digits - 1), 10**digits, num_records)
+            frac = rng.integers(0, 10**fld.scale, num_records) if fld.scale > 0 else 0
+            values = whole + (frac / (10**fld.scale) if fld.scale > 0 else 0.0)
+            values = values.astype(np.float64)
+        elif fld.ctype == ColumnType.TIMESTAMP:
+            values = rng.integers(0, 2**41, num_records).astype("datetime64[ms]").astype(
+                "datetime64[us]"
+            )
+        else:  # STRING: alphanumeric, length 1..20
+            alphabet = np.array(list(string.ascii_letters + string.digits))
+            values = np.empty(num_records, dtype=object)
+            lengths = rng.integers(1, 21, num_records)
+            for i in range(num_records):
+                values[i] = "".join(rng.choice(alphabet, lengths[i]))
+        if fld.ctype != ColumnType.STRING:
+            values = np.asarray(values)
+        columns.append(Column(fld.name, fld.ctype, values, valid))
+    return Table(columns)
+
+
+class Applicability:
+    """reference: Applicability.scala:172-237."""
+
+    def is_applicable(
+        self, check: Check, schema: Sequence[SchemaField], num_records: int = 1000
+    ) -> CheckApplicability:
+        data = generate_random_data(schema, num_records)
+        constraint_applicabilities: Dict[Constraint, bool] = {}
+        failures: List[Tuple[str, BaseException]] = []
+
+        for constraint in check.constraints:
+            inner = (
+                constraint.inner
+                if isinstance(constraint, ConstraintDecorator)
+                else constraint
+            )
+            if not isinstance(inner, AnalysisBasedConstraint):
+                constraint_applicabilities[constraint] = True
+                continue
+            metric = inner.analyzer.calculate(data)
+            ok = metric.value.is_success
+            constraint_applicabilities[constraint] = ok
+            if not ok:
+                failures.append((repr(constraint), metric.value.exception))
+
+        return CheckApplicability(
+            not failures, failures, constraint_applicabilities
+        )
+
+    def are_applicable(
+        self,
+        analyzers: Sequence,
+        schema: Sequence[SchemaField],
+        num_records: int = 1000,
+    ) -> AnalyzersApplicability:
+        data = generate_random_data(schema, num_records)
+        failures: List[Tuple[str, BaseException]] = []
+        for analyzer in analyzers:
+            metric = analyzer.calculate(data)
+            if metric.value.is_failure:
+                failures.append((metric.instance, metric.value.exception))
+        return AnalyzersApplicability(not failures, failures)

@@ -1,0 +1,17 @@
+from deequ_tpu.profiles.column_profile import (
+    ColumnProfile,
+    ColumnProfiles,
+    NumericColumnProfile,
+    StandardColumnProfile,
+)
+from deequ_tpu.profiles.column_profiler import ColumnProfiler
+from deequ_tpu.profiles.runner import ColumnProfilerRunner
+
+__all__ = [
+    "ColumnProfile",
+    "ColumnProfiles",
+    "NumericColumnProfile",
+    "StandardColumnProfile",
+    "ColumnProfiler",
+    "ColumnProfilerRunner",
+]

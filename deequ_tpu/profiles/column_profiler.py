@@ -1,0 +1,343 @@
+"""ColumnProfiler: full single-column profiles in exactly three scans.
+
+reference: profiles/ColumnProfiler.scala:54-669. Pass structure:
+  1. Size + per-column Completeness + ApproxCountDistinct (+ DataType for
+     strings) — ONE fused device pass;
+  2. numeric columns (schema-numeric or inferred-numeric strings, cast
+     host-side) get Minimum/Maximum/Mean/StandardDeviation/Sum/
+     ApproxQuantiles(0.01..1.00) — ONE fused pass (device + host-reduced
+     quantile sketches share it);
+  3. exact histograms for low-cardinality string/bool columns — one
+     group-by pass.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    ApproxQuantiles,
+    Completeness,
+    DataType,
+    Maximum,
+    Mean,
+    Minimum,
+    Size,
+    StandardDeviation,
+    Sum,
+)
+from deequ_tpu.analyzers.scan import DataTypeInstances, determine_type
+from deequ_tpu.core.metrics import Distribution, DistributionValue
+from deequ_tpu.data.table import Column, ColumnType, Table
+from deequ_tpu.profiles.column_profile import (
+    ColumnProfiles,
+    NumericColumnProfile,
+    StandardColumnProfile,
+)
+from deequ_tpu.runners.analysis_runner import AnalysisRunner
+
+DEFAULT_CARDINALITY_THRESHOLD = 120
+
+_PERCENTILES = tuple(i / 100 for i in range(1, 101))
+
+
+@dataclass
+class GenericColumnStatistics:
+    num_records: int
+    inferred_types: Dict[str, str]
+    known_types: Dict[str, str]
+    type_detection_histograms: Dict[str, Dict[str, int]]
+    approximate_num_distincts: Dict[str, int]
+    completenesses: Dict[str, float]
+
+    def type_of(self, column: str) -> str:
+        if column in self.inferred_types:
+            return self.inferred_types[column]
+        return self.known_types[column]
+
+
+class ColumnProfiler:
+    @staticmethod
+    def profile(
+        data: Table,
+        restrict_to_columns: Optional[Sequence[str]] = None,
+        print_status_updates: bool = False,
+        low_cardinality_histogram_threshold: int = DEFAULT_CARDINALITY_THRESHOLD,
+        metrics_repository=None,
+        reuse_existing_results_for_key=None,
+        fail_if_results_missing: bool = False,
+        save_in_metrics_repository_using_key=None,
+    ) -> ColumnProfiles:
+        """reference: ColumnProfiler.scala:81-188."""
+        relevant = (
+            list(restrict_to_columns)
+            if restrict_to_columns is not None
+            else data.column_names
+        )
+        for name in relevant:
+            data.column(name)  # raises NoSuchColumnException early
+
+        # ---- Pass 1 (reference: :103-126) --------------------------------
+        if print_status_updates:
+            print("### PROFILING: Computing generic column statistics in pass (1/3)...")
+        analyzers_pass1 = [Size()]
+        for name in relevant:
+            analyzers_pass1.append(Completeness(name))
+            analyzers_pass1.append(ApproxCountDistinct(name))
+            if data.column(name).ctype == ColumnType.STRING:
+                analyzers_pass1.append(DataType(name))
+
+        builder = AnalysisRunner.on_data(data).add_analyzers(analyzers_pass1)
+        if metrics_repository is not None:
+            builder = builder.use_repository(metrics_repository)
+            if reuse_existing_results_for_key is not None:
+                builder = builder.reuse_existing_results_for_key(
+                    reuse_existing_results_for_key, fail_if_results_missing
+                )
+            if save_in_metrics_repository_using_key is not None:
+                builder = builder.save_or_append_result(
+                    save_in_metrics_repository_using_key
+                )
+        results_pass1 = builder.run()
+
+        generic_stats = _extract_generic_statistics(relevant, data, results_pass1)
+
+        # ---- Pass 2 (reference: :128-153, cast at :399-417) --------------
+        if print_status_updates:
+            print("### PROFILING: Computing numeric column statistics in pass (2/3)...")
+        casted_data = _cast_numeric_string_columns(relevant, data, generic_stats)
+        numeric_columns = [
+            name
+            for name in relevant
+            if generic_stats.type_of(name)
+            in (DataTypeInstances.INTEGRAL, DataTypeInstances.FRACTIONAL)
+        ]
+        analyzers_pass2 = []
+        for name in numeric_columns:
+            analyzers_pass2.extend(
+                [
+                    Minimum(name),
+                    Maximum(name),
+                    Mean(name),
+                    StandardDeviation(name),
+                    Sum(name),
+                    ApproxQuantiles(name, _PERCENTILES),
+                ]
+            )
+        results_pass2 = (
+            AnalysisRunner.on_data(casted_data).add_analyzers(analyzers_pass2).run()
+            if analyzers_pass2
+            else None
+        )
+        numeric_stats = _extract_numeric_statistics(numeric_columns, results_pass2)
+
+        # ---- Pass 3 (reference: :487-565) --------------------------------
+        if print_status_updates:
+            print("### PROFILING: Computing histograms of low-cardinality columns in pass (3/3)...")
+        target_columns = _find_target_columns_for_histograms(
+            data, generic_stats, low_cardinality_histogram_threshold
+        )
+        histograms = _compute_histograms(data, target_columns, generic_stats.num_records)
+
+        return _create_profiles(relevant, generic_stats, numeric_stats, histograms)
+
+
+def _extract_generic_statistics(
+    columns: Sequence[str], data: Table, results
+) -> GenericColumnStatistics:
+    """reference: ColumnProfiler.scala:341-396."""
+    num_records = 0
+    inferred_types: Dict[str, str] = {}
+    type_detection: Dict[str, Dict[str, int]] = {}
+    approx_distincts: Dict[str, int] = {}
+    completenesses: Dict[str, float] = {}
+
+    for analyzer, metric in results.metric_map.items():
+        if isinstance(analyzer, Size) and metric.value.is_success:
+            num_records = int(metric.value.get())
+        elif isinstance(analyzer, DataType) and metric.value.is_success:
+            dist = metric.value.get()
+            inferred_types[analyzer.column] = determine_type(dist)
+            type_detection[analyzer.column] = {
+                key: dv.absolute for key, dv in dist.values.items()
+            }
+        elif isinstance(analyzer, ApproxCountDistinct) and metric.value.is_success:
+            approx_distincts[analyzer.column] = int(metric.value.get())
+        elif isinstance(analyzer, Completeness) and metric.value.is_success:
+            completenesses[analyzer.column] = metric.value.get()
+
+    known_types: Dict[str, str] = {}
+    for name, ctype in data.schema:
+        if name not in columns or ctype == ColumnType.STRING:
+            continue
+        known_types[name] = {
+            ColumnType.LONG: DataTypeInstances.INTEGRAL,
+            ColumnType.DOUBLE: DataTypeInstances.FRACTIONAL,
+            ColumnType.DECIMAL: DataTypeInstances.FRACTIONAL,
+            ColumnType.BOOLEAN: DataTypeInstances.BOOLEAN,
+            ColumnType.TIMESTAMP: DataTypeInstances.STRING,
+        }[ctype]
+
+    return GenericColumnStatistics(
+        num_records,
+        inferred_types,
+        known_types,
+        type_detection,
+        approx_distincts,
+        completenesses,
+    )
+
+
+def _cast_numeric_string_columns(
+    columns: Sequence[str], data: Table, stats: GenericColumnStatistics
+) -> Table:
+    """String columns inferred Integral/Fractional are cast for pass 2
+    (reference: ColumnProfiler.scala:329-339, 399-417)."""
+    out = data
+    for name in columns:
+        if name not in stats.inferred_types:
+            continue
+        inferred = stats.inferred_types[name]
+        if inferred not in (DataTypeInstances.INTEGRAL, DataTypeInstances.FRACTIONAL):
+            continue
+        col = data.column(name)
+        values, valid = col.numeric_values()
+        out = out.with_column(Column(name, ColumnType.DOUBLE, values, valid))
+    return out
+
+
+@dataclass
+class NumericColumnStatistics:
+    means: Dict[str, float] = field(default_factory=dict)
+    maxima: Dict[str, float] = field(default_factory=dict)
+    minima: Dict[str, float] = field(default_factory=dict)
+    sums: Dict[str, float] = field(default_factory=dict)
+    std_devs: Dict[str, float] = field(default_factory=dict)
+    approx_percentiles: Dict[str, List[float]] = field(default_factory=dict)
+
+
+def _extract_numeric_statistics(columns, results) -> NumericColumnStatistics:
+    stats = NumericColumnStatistics()
+    if results is None:
+        return stats
+    for analyzer, metric in results.metric_map.items():
+        if not metric.value.is_success:
+            continue
+        if isinstance(analyzer, Mean):
+            stats.means[analyzer.column] = metric.value.get()
+        elif isinstance(analyzer, Maximum):
+            stats.maxima[analyzer.column] = metric.value.get()
+        elif isinstance(analyzer, Minimum):
+            stats.minima[analyzer.column] = metric.value.get()
+        elif isinstance(analyzer, Sum):
+            stats.sums[analyzer.column] = metric.value.get()
+        elif isinstance(analyzer, StandardDeviation):
+            stats.std_devs[analyzer.column] = metric.value.get()
+        elif isinstance(analyzer, ApproxQuantiles):
+            keyed = metric.value.get()
+            ordered = [keyed[k] for k in sorted(keyed, key=float)]
+            stats.approx_percentiles[analyzer.column] = ordered
+    return stats
+
+
+def _find_target_columns_for_histograms(
+    data: Table, stats: GenericColumnStatistics, threshold: int
+) -> List[str]:
+    """string/bool columns with approx distinct <= threshold
+    (reference: ColumnProfiler.scala:487-516)."""
+    out = []
+    for name, count in stats.approximate_num_distincts.items():
+        ctype = data.column(name).ctype
+        if ctype not in (ColumnType.STRING, ColumnType.BOOLEAN):
+            continue
+        if stats.type_of(name) not in (
+            DataTypeInstances.STRING,
+            DataTypeInstances.BOOLEAN,
+        ):
+            continue
+        if count <= threshold:
+            out.append(name)
+    return out
+
+
+def _compute_histograms(
+    data: Table, target_columns: Sequence[str], num_records: int
+) -> Dict[str, Distribution]:
+    """One exact counting pass over all target columns
+    (reference: ColumnProfiler.scala:523-565)."""
+    if not target_columns:
+        return {}
+    from deequ_tpu.ops import runtime
+
+    runtime.record_group_pass("profiler-histograms:" + ",".join(target_columns))
+    histograms: Dict[str, Distribution] = {}
+    for name in target_columns:
+        col = data.column(name)
+        codes, uniques = col.dict_encode()
+        counts = np.bincount(codes + 1, minlength=len(uniques) + 1)
+        values: Dict[str, DistributionValue] = {}
+        if counts[0] > 0:
+            values["NullValue"] = DistributionValue(
+                int(counts[0]), counts[0] / num_records
+            )
+        for i, unique in enumerate(uniques):
+            count = int(counts[i + 1])
+            if count == 0:
+                continue
+            if col.ctype == ColumnType.BOOLEAN:
+                key = "true" if unique else "false"
+            else:
+                key = str(unique)
+            values[key] = DistributionValue(count, count / num_records)
+        histograms[name] = Distribution(values, number_of_bins=len(values))
+    return histograms
+
+
+def _create_profiles(
+    columns: Sequence[str],
+    generic_stats: GenericColumnStatistics,
+    numeric_stats: NumericColumnStatistics,
+    histograms: Dict[str, Distribution],
+) -> ColumnProfiles:
+    """reference: ColumnProfiler.scala:617-669."""
+    profiles = {}
+    for name in columns:
+        completeness = generic_stats.completenesses.get(name, 0.0)
+        approx_distinct = generic_stats.approximate_num_distincts.get(name, 0)
+        data_type = generic_stats.type_of(name)
+        is_inferred = name in generic_stats.inferred_types
+        type_counts = generic_stats.type_detection_histograms.get(name, {})
+        histogram = histograms.get(name)
+
+        if data_type in (DataTypeInstances.INTEGRAL, DataTypeInstances.FRACTIONAL):
+            profile = NumericColumnProfile(
+                name,
+                completeness,
+                approx_distinct,
+                data_type,
+                is_inferred,
+                type_counts,
+                histogram,
+                mean=numeric_stats.means.get(name),
+                maximum=numeric_stats.maxima.get(name),
+                minimum=numeric_stats.minima.get(name),
+                sum=numeric_stats.sums.get(name),
+                std_dev=numeric_stats.std_devs.get(name),
+                approx_percentiles=numeric_stats.approx_percentiles.get(name),
+            )
+        else:
+            profile = StandardColumnProfile(
+                name,
+                completeness,
+                approx_distinct,
+                data_type,
+                is_inferred,
+                type_counts,
+                histogram,
+            )
+        profiles[name] = profile
+    return ColumnProfiles(profiles, generic_stats.num_records)

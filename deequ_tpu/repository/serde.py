@@ -245,15 +245,22 @@ def deserialize_analyzer(data: Dict[str, Any]) -> Analyzer:
 
 
 def serialize_metric(metric: Metric) -> Dict[str, Any]:
+    import math
+
     if metric.value.is_failure:
         raise ValueError("Unable to serialize failed metrics.")
     if isinstance(metric, DoubleMetric):
+        value = metric.value.get()
+        # NaN/Inf are not RFC-8259 JSON (Gson would refuse them outright);
+        # store null so the history file stays parseable everywhere
+        if isinstance(value, float) and not math.isfinite(value):
+            value = None
         return {
             "metricName": "DoubleMetric",
             "entity": metric.entity.value,
             "instance": metric.instance,
             "name": metric.name,
-            "value": metric.value.get(),
+            "value": value,
         }
     if isinstance(metric, HistogramMetric):
         dist = metric.value.get()
@@ -277,11 +284,12 @@ def serialize_metric(metric: Metric) -> Dict[str, Any]:
 def deserialize_metric(data: Dict[str, Any]) -> Metric:
     name = data["metricName"]
     if name == "DoubleMetric":
+        value = data["value"]
         return DoubleMetric(
             Entity(data["entity"]),
             data["name"],
             data["instance"],
-            Success(data["value"]),
+            Success(float("nan") if value is None else value),
         )
     if name == "HistogramMetric":
         return HistogramMetric(
@@ -297,7 +305,7 @@ def deserialize_metric(data: Dict[str, Any]) -> Metric:
             data["instance"],
             Success({k: float(v) for k, v in data["value"].items()}),
         )
-    raise ValueError(f"Unable to deserialize analyzer {name}.")
+    raise ValueError(f"Unable to deserialize metric {name}.")
 
 
 def serialize_distribution(dist: Distribution) -> Dict[str, Any]:

@@ -1,0 +1,11 @@
+from deequ_tpu.schema.row_level_schema_validator import (
+    RowLevelSchema,
+    RowLevelSchemaValidationResult,
+    RowLevelSchemaValidator,
+)
+
+__all__ = [
+    "RowLevelSchema",
+    "RowLevelSchemaValidationResult",
+    "RowLevelSchemaValidator",
+]

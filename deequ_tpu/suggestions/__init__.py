@@ -1,0 +1,37 @@
+from deequ_tpu.suggestions.rules import (
+    DEFAULT_RULES,
+    CategoricalRangeRule,
+    CompleteIfCompleteRule,
+    ConstraintRule,
+    FractionalCategoricalRangeRule,
+    NonNegativeNumbersRule,
+    RetainCompletenessRule,
+    RetainTypeRule,
+    UniqueIfApproximatelyUniqueRule,
+)
+from deequ_tpu.suggestions.suggestion import ConstraintSuggestion
+from deequ_tpu.suggestions.runner import (
+    ConstraintSuggestionResult,
+    ConstraintSuggestionRunner,
+)
+
+
+class Rules:
+    DEFAULT = DEFAULT_RULES
+
+
+__all__ = [
+    "Rules",
+    "DEFAULT_RULES",
+    "ConstraintRule",
+    "CompleteIfCompleteRule",
+    "RetainCompletenessRule",
+    "RetainTypeRule",
+    "CategoricalRangeRule",
+    "FractionalCategoricalRangeRule",
+    "NonNegativeNumbersRule",
+    "UniqueIfApproximatelyUniqueRule",
+    "ConstraintSuggestion",
+    "ConstraintSuggestionResult",
+    "ConstraintSuggestionRunner",
+]

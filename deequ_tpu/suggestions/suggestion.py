@@ -1,0 +1,44 @@
+"""ConstraintSuggestion model + JSON export.
+
+reference: suggestions/ConstraintSuggestion.scala:25-115. The
+`code_for_constraint` strings are Python DSL snippets (the reference emits
+Scala snippets — same role, native surface).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, List
+
+if TYPE_CHECKING:
+    from deequ_tpu.constraints.constraint import Constraint
+    from deequ_tpu.suggestions.rules import ConstraintRule
+
+
+@dataclass
+class ConstraintSuggestion:
+    constraint: "Constraint"
+    column_name: str
+    current_value: str
+    description: str
+    suggesting_rule: "ConstraintRule"
+    code_for_constraint: str
+
+
+def suggestions_to_json(suggestions: List[ConstraintSuggestion]) -> str:
+    """reference: ConstraintSuggestion.scala:42+."""
+    out = []
+    for suggestion in suggestions:
+        out.append(
+            {
+                "constraint_name": repr(suggestion.constraint),
+                "column_name": suggestion.column_name,
+                "current_value": suggestion.current_value,
+                "description": suggestion.description,
+                "suggesting_rule": repr(suggestion.suggesting_rule),
+                "rule_description": suggestion.suggesting_rule.rule_description,
+                "code_for_constraint": suggestion.code_for_constraint,
+            }
+        )
+    return json.dumps({"constraint_suggestions": out}, indent=2)

@@ -300,7 +300,7 @@ class TestIncrementalStates:
         analyzers = [
             Size(),
             Completeness("att1"),
-            Mean("item2") if False else Completeness("att2"),
+            Completeness("att2"),
             Uniqueness(["att1"]),
             CountDistinct(["att1"]),
         ]

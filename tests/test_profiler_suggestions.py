@@ -1,0 +1,301 @@
+"""Profiler, suggestion, applicability and schema-validator tests
+(mirrors reference ColumnProfilerTest, ConstraintRulesTest,
+ConstraintSuggestionsIntegrationTest, ApplicabilityTest,
+RowLevelSchemaValidatorTest)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deequ_tpu.data.table import ColumnType, Table
+from deequ_tpu.ops import runtime
+from deequ_tpu.profiles import (
+    ColumnProfilerRunner,
+    NumericColumnProfile,
+    StandardColumnProfile,
+)
+from deequ_tpu.suggestions import (
+    CategoricalRangeRule,
+    CompleteIfCompleteRule,
+    ConstraintSuggestionRunner,
+    NonNegativeNumbersRule,
+    RetainCompletenessRule,
+    RetainTypeRule,
+    Rules,
+    UniqueIfApproximatelyUniqueRule,
+)
+
+
+def example_table(n=120):
+    rng = np.random.default_rng(0)
+    return Table.from_pydict(
+        {
+            "id": list(range(n)),
+            "name": [f"name_{i}" for i in range(n)],
+            "status": [["active", "inactive", "pending"][i % 3] for i in range(n)],
+            "amountStr": [str(i * 10) for i in range(n)],
+            "score": [float(i) / 2 if i % 10 != 0 else None for i in range(n)],
+            "flag": [bool(i % 2) for i in range(n)],
+        }
+    )
+
+
+class TestColumnProfiler:
+    def test_three_passes(self):
+        data = example_table()
+        with runtime.monitored() as stats:
+            profiles = ColumnProfilerRunner.on_data(data).run()
+        # pass1 fused scan + pass2 fused scan + pass3 histogram group pass
+        assert stats.jobs == 3
+        assert profiles.num_records == 120
+
+    def test_profile_contents(self):
+        data = example_table()
+        profiles = ColumnProfilerRunner.on_data(data).run()
+
+        id_profile = profiles.profiles["id"]
+        assert isinstance(id_profile, NumericColumnProfile)
+        assert id_profile.data_type == "Integral"
+        assert not id_profile.is_data_type_inferred
+        assert id_profile.completeness == 1.0
+        assert id_profile.minimum == 0.0
+        assert id_profile.maximum == 119.0
+        assert id_profile.mean == pytest.approx(59.5)
+        assert id_profile.sum == pytest.approx(7140.0)
+        assert len(id_profile.approx_percentiles) == 100
+
+        # string column inferred integral -> numeric profile with stats
+        amount = profiles.profiles["amountStr"]
+        assert isinstance(amount, NumericColumnProfile)
+        assert amount.data_type == "Integral"
+        assert amount.is_data_type_inferred
+        assert amount.minimum == 0.0
+        assert amount.maximum == 1190.0
+
+        status = profiles.profiles["status"]
+        assert isinstance(status, StandardColumnProfile)
+        assert status.data_type == "String"
+        assert status.histogram is not None
+        assert status.histogram["active"].absolute == 40
+
+        score = profiles.profiles["score"]
+        assert score.completeness == pytest.approx(108 / 120)
+
+        flag = profiles.profiles["flag"]
+        assert flag.data_type == "Boolean"
+        assert flag.histogram is not None
+        assert flag.histogram["true"].absolute == 60
+
+    def test_restrict_to_columns(self):
+        data = example_table()
+        profiles = (
+            ColumnProfilerRunner.on_data(data).restrict_to_columns(["id", "status"]).run()
+        )
+        assert set(profiles.profiles) == {"id", "status"}
+
+    def test_cardinality_threshold(self):
+        data = example_table()
+        profiles = (
+            ColumnProfilerRunner.on_data(data)
+            .with_low_cardinality_histogram_threshold(2)
+            .run()
+        )
+        assert profiles.profiles["status"].histogram is None
+
+    def test_json_export(self, tmp_path):
+        data = example_table()
+        path = str(tmp_path / "profiles.json")
+        ColumnProfilerRunner.on_data(data).save_column_profiles_json_to_path(path).run()
+        with open(path) as f:
+            parsed = json.load(f)
+        by_column = {c["column"]: c for c in parsed["columns"]}
+        assert by_column["id"]["dataType"] == "Integral"
+        assert "histogram" in by_column["status"]
+
+
+class TestSuggestionRules:
+    def profile_for(self, data):
+        from deequ_tpu.profiles import ColumnProfiler
+
+        return ColumnProfilerRunner.on_data(data).run()
+
+    def test_complete_if_complete(self):
+        profiles = self.profile_for(example_table())
+        rule = CompleteIfCompleteRule()
+        assert rule.should_be_applied(profiles.profiles["id"], 120)
+        assert not rule.should_be_applied(profiles.profiles["score"], 120)
+        suggestion = rule.candidate(profiles.profiles["id"], 120)
+        assert suggestion.code_for_constraint == '.is_complete("id")'
+
+    def test_retain_completeness(self):
+        profiles = self.profile_for(example_table())
+        rule = RetainCompletenessRule()
+        assert rule.should_be_applied(profiles.profiles["score"], 120)
+        suggestion = rule.candidate(profiles.profiles["score"], 120)
+        assert ".has_completeness" in suggestion.code_for_constraint
+
+    def test_retain_type(self):
+        profiles = self.profile_for(example_table())
+        rule = RetainTypeRule()
+        assert rule.should_be_applied(profiles.profiles["amountStr"], 120)
+        assert not rule.should_be_applied(profiles.profiles["id"], 120)  # not inferred
+        suggestion = rule.candidate(profiles.profiles["amountStr"], 120)
+        assert "ConstrainableDataTypes.INTEGRAL" in suggestion.code_for_constraint
+
+    def test_categorical_range(self):
+        profiles = self.profile_for(example_table())
+        rule = CategoricalRangeRule()
+        assert rule.should_be_applied(profiles.profiles["status"], 120)
+        suggestion = rule.candidate(profiles.profiles["status"], 120)
+        assert '"active"' in suggestion.code_for_constraint
+
+    def test_non_negative(self):
+        profiles = self.profile_for(example_table())
+        rule = NonNegativeNumbersRule()
+        assert rule.should_be_applied(profiles.profiles["id"], 120)
+        suggestion = rule.candidate(profiles.profiles["id"], 120)
+        assert suggestion.code_for_constraint == '.is_non_negative("id")'
+
+    def test_unique_if_approximately_unique(self):
+        profiles = self.profile_for(example_table())
+        rule = UniqueIfApproximatelyUniqueRule()
+        assert rule.should_be_applied(profiles.profiles["id"], 120)
+        assert not rule.should_be_applied(profiles.profiles["status"], 120)
+
+
+class TestSuggestionRunner:
+    def test_end_to_end(self):
+        data = example_table()
+        result = (
+            ConstraintSuggestionRunner.on_data(data)
+            .add_constraint_rules(Rules.DEFAULT)
+            .run()
+        )
+        codes = [s.code_for_constraint for s in result.all_suggestions()]
+        assert '.is_complete("id")' in codes
+        assert any(".is_contained_in" in c for c in codes)
+        parsed = json.loads(result.suggestions_as_json())
+        assert len(parsed["constraint_suggestions"]) == len(codes)
+
+    def test_train_test_split_evaluation(self):
+        data = example_table(400)
+        result = (
+            ConstraintSuggestionRunner.on_data(data)
+            .add_constraint_rules(Rules.DEFAULT)
+            .use_train_test_split_with_test_set_ratio(0.25, seed=7)
+            .run()
+        )
+        assert result.verification_result is not None
+        # generated constraints should mostly hold on the test split
+        check_result = list(result.verification_result.check_results.values())[0]
+        from deequ_tpu.constraints.constraint import ConstraintStatus
+
+        statuses = [r.status for r in check_result.constraint_results]
+        assert statuses.count(ConstraintStatus.SUCCESS) >= len(statuses) - 1
+
+
+class TestApplicability:
+    def test_applicable_check(self):
+        from deequ_tpu import Check, CheckLevel
+        from deequ_tpu.applicability import Applicability
+        from deequ_tpu.applicability.applicability import SchemaField
+
+        schema = [
+            SchemaField("item", ColumnType.STRING),
+            SchemaField("count", ColumnType.LONG, nullable=False),
+        ]
+        check = (
+            Check(CheckLevel.ERROR, "c")
+            .is_complete("count")
+            .has_min("count", lambda v: v > -(2**32))
+        )
+        result = Applicability().is_applicable(check, schema)
+        assert result.is_applicable
+
+    def test_detects_missing_column(self):
+        from deequ_tpu import Check, CheckLevel
+        from deequ_tpu.applicability import Applicability
+        from deequ_tpu.applicability.applicability import SchemaField
+
+        schema = [SchemaField("item", ColumnType.STRING)]
+        check = Check(CheckLevel.ERROR, "c").is_complete("notHere")
+        result = Applicability().is_applicable(check, schema)
+        assert not result.is_applicable
+        assert len(result.failures) == 1
+
+    def test_detects_invalid_sql(self):
+        from deequ_tpu import Check, CheckLevel
+        from deequ_tpu.applicability import Applicability
+        from deequ_tpu.applicability.applicability import SchemaField
+
+        schema = [SchemaField("item", ColumnType.STRING)]
+        check = Check(CheckLevel.ERROR, "c").satisfies("!!invalid sql!!", "bad")
+        result = Applicability().is_applicable(check, schema)
+        assert not result.is_applicable
+
+    def test_generated_data_shapes(self):
+        from deequ_tpu.applicability.applicability import SchemaField, generate_random_data
+
+        schema = [
+            SchemaField("s", ColumnType.STRING),
+            SchemaField("i", ColumnType.LONG),
+            SchemaField("f", ColumnType.DOUBLE),
+            SchemaField("b", ColumnType.BOOLEAN),
+            SchemaField("d", ColumnType.DECIMAL, precision=6, scale=2),
+            SchemaField("t", ColumnType.TIMESTAMP),
+            SchemaField("nn", ColumnType.LONG, nullable=False),
+        ]
+        data = generate_random_data(schema, 1000, seed=1)
+        assert data.num_rows == 1000
+        assert data["nn"].null_count == 0
+        # ~1% nulls for nullable fields
+        assert 0 <= data["s"].null_count <= 50
+
+
+class TestRowLevelSchemaValidator:
+    def test_valid_invalid_split(self):
+        from deequ_tpu.schema import RowLevelSchema, RowLevelSchemaValidator
+
+        data = Table.from_pydict(
+            {
+                "id": ["1", "2", "x", "4", None],
+                "name": ["a", "bb", "ccc", "", "e"],
+                "ts": [
+                    "2024-01-01 10:00:00",
+                    "2024-02-30 10:00:00",  # invalid date
+                    "2024-03-01 11:00:00",
+                    "2024-04-01 12:00:00",
+                    "2024-05-01 13:00:00",
+                ],
+            }
+        )
+        schema = (
+            RowLevelSchema()
+            .with_int_column("id", is_nullable=False, min_value=1)
+            .with_string_column("name", min_length=1)
+            .with_timestamp_column("ts", mask="yyyy-MM-dd HH:mm:ss")
+        )
+        result = RowLevelSchemaValidator.validate(data, schema)
+        # row0 ok; row1 bad ts; row2 bad int; row3 empty name; row4 null id
+        assert result.num_valid_rows == 1
+        assert result.num_invalid_rows == 4
+        assert result.valid_rows["id"].ctype == ColumnType.LONG
+        assert int(result.valid_rows["id"].values[0]) == 1
+
+    def test_int_bounds(self):
+        from deequ_tpu.schema import RowLevelSchema, RowLevelSchemaValidator
+
+        data = Table.from_pydict({"v": ["5", "15", "25"]})
+        schema = RowLevelSchema().with_int_column("v", min_value=10, max_value=20)
+        result = RowLevelSchemaValidator.validate(data, schema)
+        assert result.num_valid_rows == 1
+        assert int(result.valid_rows["v"].values[0]) == 15
+
+    def test_string_regex(self):
+        from deequ_tpu.schema import RowLevelSchema, RowLevelSchemaValidator
+
+        data = Table.from_pydict({"code": ["AB-1", "XY-2", "bad"]})
+        schema = RowLevelSchema().with_string_column("code", matches=r"^[A-Z]{2}-\d$")
+        result = RowLevelSchemaValidator.validate(data, schema)
+        assert result.num_valid_rows == 2
