@@ -1,0 +1,7 @@
+from deequ_tpu.parallel.distributed import (
+    DistributedScanPass,
+    data_mesh,
+    run_distributed_analysis,
+)
+
+__all__ = ["DistributedScanPass", "data_mesh", "run_distributed_analysis"]

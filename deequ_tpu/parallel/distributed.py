@@ -1,0 +1,213 @@
+"""Distributed fused scan: shard rows over a device mesh, merge states
+with collectives.
+
+This is the TPU-native form of the reference's partition-parallel
+aggregation (reference: SURVEY.md §2.10 — Spark map-side partial
+aggregation + driver merge): each device reduces its row shard with the
+SAME fused computation the single-chip path uses, then the semigroup merge
+(`State.sum`, analyzers/Analyzer.scala:34-48) runs IN-GRAPH as an
+all_gather over the tiny state pytrees followed by a static fold of each
+analyzer's `merge_agg` — sums lower to psum-like collectives, min/max to
+pmin/pmax, HLL registers to an elementwise-max reduction, all riding ICI.
+
+Scales unchanged to multi-host: the mesh can span hosts (DCN) because only
+state pytrees (bytes to KB) cross device boundaries, never rows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deequ_tpu.analyzers.base import ScanShareableAnalyzer
+from deequ_tpu.data.table import Table
+from deequ_tpu.ops import runtime
+from deequ_tpu.ops.fused import AnalyzerRunResult, _pad_size, _to_f64
+
+DATA_AXIS = "data"
+
+_DIST_CACHE: Dict[Any, Any] = {}
+
+
+def data_mesh(devices: Optional[Sequence] = None, axis_name: str = DATA_AXIS) -> Mesh:
+    """1-D data-parallel mesh over all (or given) devices."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def _get_distributed_fn(analyzers, mesh: Mesh, axis_name: str):
+    key = (
+        tuple(repr(a) for a in analyzers),
+        id(mesh),
+        axis_name,
+        bool(jax.config.jax_enable_x64),
+    )
+    fn = _DIST_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    n_devices = mesh.shape[axis_name]
+
+    def per_device(inputs):
+        # local shard reduce: identical computation to the single-chip pass
+        partials = tuple(a.device_reduce(inputs, jnp) for a in analyzers)
+
+        # in-graph semigroup merge: all_gather the state pytrees (tiny),
+        # then a static fold with each analyzer's merge law
+        gathered = jax.tree_util.tree_map(
+            lambda x: jax.lax.all_gather(x, axis_name), partials
+        )
+
+        merged = []
+        for analyzer, tree in zip(analyzers, gathered):
+            acc = jax.tree_util.tree_map(lambda x: x[0], tree)
+            for d in range(1, n_devices):
+                shard = jax.tree_util.tree_map(lambda x, d=d: x[d], tree)
+                acc = analyzer.merge_agg(acc, shard, jnp)
+            merged.append(acc)
+        return tuple(merged)
+
+    sharded = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(axis_name),),
+        out_specs=P(),  # merged states are replicated
+        check_vma=False,
+    )
+    fn = jax.jit(sharded)
+    _DIST_CACHE[key] = fn
+    return fn
+
+
+class DistributedScanPass:
+    """Mesh-sharded variant of FusedScanPass (device-reduced analyzers;
+    host-reduced ones keep their host fold)."""
+
+    def __init__(
+        self,
+        analyzers: Sequence[ScanShareableAnalyzer],
+        mesh: Optional[Mesh] = None,
+        batch_size_per_device: int = 1 << 21,
+        axis_name: str = DATA_AXIS,
+    ):
+        self.analyzers = list(analyzers)
+        self.mesh = mesh if mesh is not None else data_mesh()
+        self.axis_name = axis_name
+        self.batch_size_per_device = batch_size_per_device
+
+    def run(self, table: Table) -> List[AnalyzerRunResult]:
+        device_analyzers: List[ScanShareableAnalyzer] = []
+        device_idx: List[int] = []
+        host_idx: List[int] = []
+        host_reducers: List[Any] = []
+        results: Dict[int, AnalyzerRunResult] = {}
+        specs: Dict[str, Any] = {}
+
+        for i, analyzer in enumerate(self.analyzers):
+            if getattr(analyzer, "host_reduced", False):
+                try:
+                    host_reducers.append(analyzer.host_prepare())
+                    host_idx.append(i)
+                except Exception as e:  # noqa: BLE001
+                    results[i] = AnalyzerRunResult(analyzer, error=e)
+                continue
+            try:
+                for spec in analyzer.input_specs():
+                    specs.setdefault(spec.key, spec)
+                device_analyzers.append(analyzer)
+                device_idx.append(i)
+            except Exception as e:  # noqa: BLE001
+                results[i] = AnalyzerRunResult(analyzer, error=e)
+
+        n_devices = self.mesh.shape[self.axis_name]
+        global_batch = self.batch_size_per_device * n_devices
+        dtype = runtime.compute_dtype()
+        fn = (
+            _get_distributed_fn(device_analyzers, self.mesh, self.axis_name)
+            if device_analyzers
+            else None
+        )
+        runtime.record_pass(
+            f"dist-scan[{n_devices}x]:"
+            + ",".join(a.name for a in self.analyzers)
+        )
+        in_sharding = jax.tree_util.tree_map(
+            lambda _: NamedSharding(self.mesh, P(self.axis_name)), specs
+        )
+
+        try:
+            total: Optional[List[Any]] = None
+            host_states: List[Any] = [None] * len(host_idx)
+            for batch in table.batches(global_batch):
+                if fn is not None:
+                    # pad to a multiple of n_devices (pow2 per device shard)
+                    per_dev = _pad_size(
+                        -(-batch.num_rows // n_devices), self.batch_size_per_device
+                    )
+                    padded = per_dev * n_devices
+                    inputs: Dict[str, Any] = {}
+                    for key, spec in specs.items():
+                        arr = runtime.pad_to(np.asarray(spec.build(batch)), padded)
+                        if not (
+                            arr.dtype == np.bool_
+                            or np.issubdtype(arr.dtype, np.integer)
+                        ):
+                            arr = arr.astype(dtype)
+                        inputs[key] = jax.device_put(arr, in_sharding[key])
+                    runtime.record_launch()
+                    device_out = fn(inputs)
+                for j, reducer in enumerate(host_reducers):
+                    partial = reducer(batch)
+                    if partial is not None:
+                        host_states[j] = (
+                            partial
+                            if host_states[j] is None
+                            else host_states[j].merge(partial)
+                        )
+                if fn is not None:
+                    batch_aggs = [_to_f64(t) for t in jax.device_get(device_out)]
+                    if total is None:
+                        total = batch_aggs
+                    else:
+                        total = [
+                            a.merge_agg(t, b, np)
+                            for a, t, b in zip(device_analyzers, total, batch_aggs)
+                        ]
+            for i, analyzer, agg in zip(
+                device_idx, device_analyzers, total if total is not None else []
+            ):
+                results[i] = AnalyzerRunResult(
+                    analyzer, state=analyzer.state_from_aggregates(agg)
+                )
+            for i, state in zip(host_idx, host_states):
+                results[i] = AnalyzerRunResult(self.analyzers[i], state=state)
+        except Exception as e:  # noqa: BLE001
+            for i in device_idx + host_idx:
+                results[i] = AnalyzerRunResult(self.analyzers[i], error=e)
+
+        return [results[i] for i in range(len(self.analyzers))]
+
+
+def run_distributed_analysis(
+    table: Table,
+    analyzers: Sequence[ScanShareableAnalyzer],
+    mesh: Optional[Mesh] = None,
+    batch_size_per_device: int = 1 << 21,
+):
+    """Convenience: sharded pass -> AnalyzerContext."""
+    from deequ_tpu.runners.context import AnalyzerContext
+
+    results = DistributedScanPass(
+        analyzers, mesh=mesh, batch_size_per_device=batch_size_per_device
+    ).run(table)
+    metrics = {}
+    for result in results:
+        if result.error is not None:
+            metrics[result.analyzer] = result.analyzer.to_failure_metric(result.error)
+        else:
+            metrics[result.analyzer] = result.analyzer.compute_metric_from(result.state)
+    return AnalyzerContext(metrics)
