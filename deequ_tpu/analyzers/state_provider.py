@@ -2,10 +2,12 @@
 
 reference: analyzers/StateProvider.scala:36-295. The filesystem provider
 keeps the reference's binary layouts (big-endian, Java DataOutputStream
-conventions) per analyzer type so states interoperate where the underlying
-sketch is format-compatible; files are keyed by a hash of the analyzer's
-identity string like the reference's MurmurHash3(analyzer.toString)
-(StateProvider.scala:81-83).
+conventions) per analyzer type, so the *payload* of a state file is
+format-compatible where the underlying sketch is. File *naming* is not
+interoperable: files are keyed by SHA-1[:16] of repr(analyzer), whereas
+the reference keys by MurmurHash3(analyzer.toString)
+(StateProvider.scala:81-83) — a state written by one implementation is
+not discovered by the other without renaming.
 """
 
 from __future__ import annotations

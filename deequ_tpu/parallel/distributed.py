@@ -40,9 +40,12 @@ def data_mesh(devices: Optional[Sequence] = None, axis_name: str = DATA_AXIS) ->
 
 
 def _get_distributed_fn(analyzers, mesh: Mesh, axis_name: str):
+    # Mesh hashes/compares by content (devices + axis names), giving a
+    # stable cache identity — unlike id(mesh), which can be recycled
+    # after GC and return a function compiled for a dead mesh.
     key = (
         tuple(repr(a) for a in analyzers),
-        id(mesh),
+        mesh,
         axis_name,
         bool(jax.config.jax_enable_x64),
     )

@@ -6,6 +6,12 @@ per-analyzer dispatch on `analyzerName`, metric serialization by
 `metricName`, and the refusal to serialize failed metrics / binning-udf
 histograms all mirror the reference so JSON written by either
 implementation loads in the other.
+
+Documented deviation: a non-finite DoubleMetric value (NaN/Inf) is stored
+as JSON null here so the history file stays RFC-8259 parseable, whereas
+the reference's Gson would throw when *writing* such a value and throws on
+JsonNull when *reading* — i.e. histories containing non-finite metrics are
+writable only by this implementation and loadable only by it.
 """
 
 from __future__ import annotations

@@ -161,8 +161,16 @@ class RowLevelSchemaValidator:
                 )
             elif isinstance(definition, DecimalColumnDefinition):
                 values, valid = col.numeric_values()
-                ok &= is_null | valid
-                cast_col = Column(definition.name, ColumnType.DECIMAL, values, valid)
+                # Spark's cast to Decimal(precision, scale) rounds HALF_UP
+                # to `scale`, then marks rows whose integral part exceeds
+                # precision-scale digits as invalid
+                # (reference: schema/RowLevelSchemaValidator.scala:209-214)
+                factor = 10.0 ** definition.scale
+                rounded = np.sign(values) * np.floor(np.abs(values) * factor + 0.5) / factor
+                int_digits = definition.precision - definition.scale
+                fits = valid & (np.abs(rounded) < 10.0 ** int_digits)
+                ok &= is_null | fits
+                cast_col = Column(definition.name, ColumnType.DECIMAL, rounded, fits)
             elif isinstance(definition, TimestampColumnDefinition):
                 parsed, parse_ok = _parse_timestamps(col, definition.mask)
                 ok &= is_null | parse_ok
@@ -191,6 +199,12 @@ class RowLevelSchemaValidator:
         )
 
 
+# Spark's integer cast accepts only an optional sign + decimal digits;
+# Python's int() is looser (underscore separators, unicode digits), so
+# pre-validate with the strict form.
+_STRICT_INT_RE = re.compile(r"^[+-]?[0-9]+$")
+
+
 def _parse_ints(col: Column):
     n = len(col)
     parsed = np.zeros(n, dtype=np.int64)
@@ -198,10 +212,13 @@ def _parse_ints(col: Column):
     for i in range(n):
         if not col.valid[i]:
             continue
+        s = str(col.values[i]).strip()
+        if not _STRICT_INT_RE.match(s):
+            continue
         try:
-            parsed[i] = int(str(col.values[i]).strip())
+            parsed[i] = int(s)
             ok[i] = True
-        except (TypeError, ValueError):
+        except (TypeError, ValueError, OverflowError):
             pass
     return parsed, ok
 

@@ -17,11 +17,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ.setdefault("JAX_ENABLE_X64", "true")
 
 # jaxtyping's pytest plugin imports jax before this conftest runs, so the
-# env var alone is too late for x64 — push the (possibly user-overridden)
-# env value through the live config (safe post-import; the backend is not
-# initialized yet, so the platform/device env vars above still take effect).
+# env vars alone can be too late — on a machine with a real accelerator the
+# backend would otherwise initialize with 1 TPU device instead of 8 virtual
+# CPU devices. Push platform + device count + x64 through the live config
+# (safe post-import: the backend is not initialized until first use).
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update(
     "jax_enable_x64", os.environ["JAX_ENABLE_X64"].lower() in ("1", "true")
 )
