@@ -1,0 +1,154 @@
+"""Benchmark harness: ColumnProfiler throughput on one chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": N}
+
+Workload (BASELINE.md bottom row / BASELINE.json configs): a full
+ColumnProfiler run — the reference's 3-pass profile
+(reference: profiles/ColumnProfiler.scala:81-188) — over a wide mixed
+table (numeric, boolean, low-cardinality string, numeric-string columns),
+the shape of the TPC-H-style profiling workloads the reference targets.
+
+Baseline: Spark local-mode deequ profiling throughput. Spark is not in
+this image, so the number is a documented proxy (see BENCH.md): 2.0M
+rows/s for a full profile of a ~6-column mixed table on a modern
+multi-core host — deliberately generous to Spark. vs_baseline is
+our rows/s divided by that proxy; the build target is >=10.
+
+Knobs (env):
+    BENCH_ROWS      rows to profile           (default 10_000_000)
+    BENCH_MODE      "profiler" | "scan"       (default "profiler")
+    BENCH_TIMED     timed repetitions          (default 1; steady-state
+                     timing — compile happens during the warmup run)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# Spark local-mode full-profile proxy, rows/s (justification: BENCH.md)
+SPARK_LOCAL_PROFILE_ROWS_PER_SEC = 2.0e6
+# Spark local-mode fused scalar-scan proxy, rows/s (BENCH.md)
+SPARK_LOCAL_SCAN_ROWS_PER_SEC = 10.0e6
+
+CATEGORIES = np.array(
+    ["auto", "beauty", "books", "garden", "grocery", "home", "music",
+     "office", "outdoors", "pets", "sports", "tools", "toys", "video"],
+    dtype=object,
+)
+
+
+def build_table(n_rows: int, seed: int = 0):
+    """Wide mixed table: 3 numeric, 1 bool, 2 string (low + mid card)."""
+    from deequ_tpu.data.table import Table
+
+    rng = np.random.default_rng(seed)
+    price = rng.lognormal(3.0, 1.0, n_rows)
+    price[rng.random(n_rows) < 0.02] = np.nan  # 2% nulls
+    qty = rng.integers(1, 100, n_rows)
+    discount = rng.random(n_rows)
+    flag = rng.random(n_rows) < 0.5
+    category = CATEGORIES[rng.integers(0, len(CATEGORIES), n_rows)]
+    # numeric-looking string column (profiler infers Integral, casts, and
+    # runs the numeric pass on it — the reference's pass-2 cast path)
+    code_dict = np.array([str(v) for v in rng.integers(0, 100_000, 4096)],
+                        dtype=object)
+    code = code_dict[rng.integers(0, len(code_dict), n_rows)]
+    return Table.from_numpy(
+        {"price": price, "qty": qty, "discount": discount,
+         "flag": flag, "category": category, "code": code}
+    )
+
+
+def run_profiler(table):
+    from deequ_tpu.profiles.column_profiler import ColumnProfiler
+
+    return ColumnProfiler.profile(table)
+
+
+def run_scan(table):
+    """BASELINE.json config 2: fused scalar scan (Mean/StdDev/Min/Max +
+    friends) on numeric columns — one pass."""
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        Completeness,
+        Maximum,
+        Mean,
+        Minimum,
+        Size,
+        StandardDeviation,
+        Sum,
+    )
+    from deequ_tpu.ops.fused import FusedScanPass
+
+    analyzers = [
+        Size(),
+        Completeness("price"),
+        Mean("price"),
+        Minimum("price"),
+        Maximum("price"),
+        Sum("price"),
+        StandardDeviation("price"),
+        ApproxCountDistinct("qty"),
+        Mean("discount"),
+        StandardDeviation("discount"),
+    ]
+    results = FusedScanPass(analyzers).run(table)
+    for r in results:
+        r.state_or_raise()
+    return results
+
+
+def main() -> None:
+    n_rows = int(os.environ.get("BENCH_ROWS", "10000000"))
+    mode = os.environ.get("BENCH_MODE", "profiler")
+    reps = max(1, int(os.environ.get("BENCH_TIMED", "1")))
+
+    t_gen = time.perf_counter()
+    table = build_table(n_rows)
+    gen_s = time.perf_counter() - t_gen
+
+    run = run_profiler if mode == "profiler" else run_scan
+    baseline = (
+        SPARK_LOCAL_PROFILE_ROWS_PER_SEC
+        if mode == "profiler"
+        else SPARK_LOCAL_SCAN_ROWS_PER_SEC
+    )
+
+    # warmup: compiles every (analyzer-set, padded-shape) program
+    t_warm = time.perf_counter()
+    run(table)
+    warm_s = time.perf_counter() - t_warm
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        run(table)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    rows_per_sec = n_rows / best
+
+    print(
+        f"# bench: mode={mode} rows={n_rows} gen={gen_s:.1f}s "
+        f"warmup={warm_s:.1f}s timed={best:.2f}s",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"{mode}_rows_per_sec_per_chip",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(rows_per_sec / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -281,16 +281,18 @@ class Patterns:
 
 
 def _match_spec(column: str, pattern: str) -> InputSpec:
-    rx = re.compile(pattern)
+    re.compile(pattern)  # fail fast on a bad pattern, at spec-build time
 
     def build(t: Table) -> np.ndarray:
+        from deequ_tpu.ops.strings import match_pattern
+
         col = t.column(column)
+        # regex only the unique values (typically << rows), gather to rows
+        codes, uniques = col.dict_encode()
+        unique_hit = match_pattern(uniques, pattern)
         out = np.zeros(len(col), dtype=np.bool_)
-        idx = np.nonzero(col.valid)[0]
-        for i in idx:
-            m = rx.search(str(col.values[i]))
-            # Spark: regexp_extract(col, regex, 0) != "" — empty match is a miss
-            out[i] = m is not None and m.group(0) != ""
+        sel = codes >= 0
+        out[sel] = unique_hit[codes[sel]]
         return out
 
     return InputSpec(key=f"match:{column}:{pattern}", build=build)
@@ -640,36 +642,31 @@ class DataTypeInstances:
     STRING = "String"
 
 
-# value-classification regexes (reference: catalyst/StatefulDataType.scala:36-38)
-_FRACTIONAL_RE = re.compile(r"^(-|\+)? ?\d*\.\d*$")
-_INTEGRAL_RE = re.compile(r"^(-|\+)? ?\d*$")
-_BOOLEAN_RE = re.compile(r"^(true|false)$")
-
 # class codes used on device: order matches DataTypeHistogram fields
-_CODE_NULL, _CODE_FRACTIONAL, _CODE_INTEGRAL, _CODE_BOOLEAN, _CODE_STRING = range(5)
-
-
-def _classify_strings(values: np.ndarray, valid: np.ndarray) -> np.ndarray:
-    codes = np.zeros(len(values), dtype=np.int32)
-    idx = np.nonzero(valid)[0]
-    for i in idx:
-        v = str(values[i])
-        if _FRACTIONAL_RE.match(v):
-            codes[i] = _CODE_FRACTIONAL
-        elif _INTEGRAL_RE.match(v):
-            codes[i] = _CODE_INTEGRAL
-        elif _BOOLEAN_RE.match(v):
-            codes[i] = _CODE_BOOLEAN
-        else:
-            codes[i] = _CODE_STRING
-    return codes
+# (value classification itself — the reference's regexes
+# catalyst/StatefulDataType.scala:36-38 — is the vectorized kernel in
+# deequ_tpu/ops/strings.py:classify, run over unique values only)
+from deequ_tpu.ops.strings import (  # noqa: E402
+    CODE_BOOLEAN as _CODE_BOOLEAN,
+    CODE_FRACTIONAL as _CODE_FRACTIONAL,
+    CODE_INTEGRAL as _CODE_INTEGRAL,
+    CODE_NULL as _CODE_NULL,
+    CODE_STRING as _CODE_STRING,
+)
 
 
 def _dtclass_spec(column: str) -> InputSpec:
     def build(t: Table) -> np.ndarray:
+        from deequ_tpu.ops.strings import classify
+
         col = t.column(column)
         if col.ctype == ColumnType.STRING:
-            return _classify_strings(col.values, col.valid)
+            dict_codes, uniques = col.dict_encode()
+            unique_codes = classify(uniques)
+            codes = np.zeros(len(col), dtype=np.int32)
+            sel = dict_codes >= 0
+            codes[sel] = unique_codes[dict_codes[sel]]
+            return codes
         # typed columns classify statically from the stringified form
         static = {
             ColumnType.LONG: _CODE_INTEGRAL,

@@ -72,6 +72,16 @@ def _hll_spec(column: str) -> InputSpec:
 
     def build(t: Table) -> np.ndarray:
         col = t.column(column)
+        if col.values.dtype == object:
+            # share the batch's dict-encode; hash unique strings only
+            from deequ_tpu.ops.strings import hash_strings
+
+            codes, uniques = col.dict_encode()
+            idx_u, rank_u = hll.registers_from_hashes(hash_strings(uniques))
+            packed = np.zeros(len(col), dtype=np.int32)
+            sel = codes >= 0
+            packed[sel] = ((idx_u << 6) | rank_u)[codes[sel]]
+            return packed
         hashes = hll.hash_column(col.values, col.valid)
         idx_v, rank_v = hll.registers_from_hashes(hashes)
         packed = np.zeros(len(col), dtype=np.int32)

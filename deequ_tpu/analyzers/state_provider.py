@@ -8,6 +8,14 @@ interoperable: files are keyed by SHA-1[:16] of repr(analyzer), whereas
 the reference keys by MurmurHash3(analyzer.toString)
 (StateProvider.scala:81-83) — a state written by one implementation is
 not discovered by the other without renaming.
+
+CAUTION on sketch states across engine versions: HLL registers are a
+function of the engine's value hash. If the hash changes between builds
+(it did when string hashing moved from per-row blake2b to the vectorized
+bucket hash), persisted ApproxCountDistinct states from the older build
+merge incorrectly with new ones — the same value lands in different
+registers and is double-counted. Invalidate persisted HLL states when
+upgrading across a hash change.
 """
 
 from __future__ import annotations

@@ -362,15 +362,22 @@ def _to_num(s: Series) -> Series:
         return s
     if kind == "bool":
         return vals.astype(np.float64), null, "num"
+    # same parse as Column.numeric_values (ops/strings.parse_floats), so
+    # a Compliance predicate and a Mean/Sum analyzer agree on which rows
+    # of a string column are numeric — vectorized over unique values
+    from deequ_tpu.ops.strings import parse_floats
+
+    present = ~null
+    if not present.any():
+        return np.zeros(len(vals)), null.copy(), "num"
+    uniques, inv = np.unique(
+        np.asarray(vals[present], dtype=object).astype(str), return_inverse=True
+    )
+    u_vals, u_ok = parse_floats(uniques)
     out = np.zeros(len(vals))
     extra_null = np.zeros(len(vals), dtype=bool)
-    for i, v in enumerate(vals):
-        if null[i]:
-            continue
-        try:
-            out[i] = float(v)
-        except (TypeError, ValueError):
-            extra_null[i] = True
+    out[present] = u_vals[inv]
+    extra_null[present] = ~u_ok[inv]
     return out, null | extra_null, "num"
 
 

@@ -43,8 +43,10 @@ NUMPY_BACKING = {
 class Column:
     """One column: dense values + validity mask (True = present).
 
-    Null slots in ``values`` hold an arbitrary fill (0 / "" / epoch); all
-    reductions go through ``valid``.
+    CONTRACT: null slots in ``values`` hold the neutral fill (0 / "" /
+    epoch) — never NaN — so masked reductions can consume the backing
+    array directly (0 * mask == 0; NaN would poison every sum). All
+    constructors enforce this; build Columns through them.
     """
 
     name: str
@@ -54,6 +56,9 @@ class Column:
 
     def __post_init__(self):
         assert len(self.values) == len(self.valid)
+        # per-instance memo for derived encodings (dict codes, parsed
+        # numerics) shared by every analyzer reading this batch's column
+        object.__setattr__(self, "_cache", {})
 
     def __len__(self) -> int:
         return len(self.values)
@@ -66,32 +71,63 @@ class Column:
         return self.values[self.valid]
 
     def slice(self, start: int, stop: int) -> "Column":
-        return Column(
+        child = Column(
             self.name, self.ctype, self.values[start:stop], self.valid[start:stop]
         )
+        # derived encodings (dict codes, parsed numerics) are row-wise, so
+        # a slice can reuse the parent's arrays — string columns are then
+        # encoded ONCE per table, not once per batch per pass
+        object.__setattr__(child, "_parent", (self, start, stop))
+        return child
 
     def take(self, indices: np.ndarray) -> "Column":
         return Column(self.name, self.ctype, self.values[indices], self.valid[indices])
 
     def numeric_values(self) -> Tuple[np.ndarray, np.ndarray]:
         """(float64 values, valid) — strings that don't parse as numbers
-        become invalid (null), matching the expr-engine coercion."""
+        become invalid (null), matching the expr-engine coercion.
+
+        Returned arrays are shared (cached / possibly the column's own
+        backing store): callers must treat them as immutable."""
         if self.ctype == ColumnType.BOOLEAN:
-            return self.values.astype(np.float64), self.valid.copy()
+            return self.values.astype(np.float64), self.valid
         if self.ctype == ColumnType.TIMESTAMP:
             vals = self.values.astype("datetime64[us]").astype(np.int64).astype(np.float64)
-            return vals, self.valid.copy()
+            return vals, self.valid
         if self.ctype == ColumnType.STRING:
-            out = np.zeros(len(self.values), dtype=np.float64)
-            valid = self.valid.copy()
-            idx = np.nonzero(self.valid)[0]
-            for i in idx:
-                try:
-                    out[i] = float(self.values[i])
-                except (TypeError, ValueError):
-                    valid[i] = False
-            return out, valid
-        return np.where(self.valid, self.values.astype(np.float64), 0.0), self.valid.copy()
+            cached = self._cache.get("numeric_values")
+            if cached is None:
+                parent = getattr(self, "_parent", None)
+                if parent is not None:
+                    p, start, stop = parent
+                    p_vals, p_valid = p.numeric_values()
+                    cached = (p_vals[start:stop], p_valid[start:stop])
+                else:
+                    from deequ_tpu.ops.strings import parse_floats
+
+                    codes, uniques = self.dict_encode()
+                    u_vals, u_ok = parse_floats(uniques)
+                    out = np.zeros(len(self.values), dtype=np.float64)
+                    valid = np.zeros(len(self.values), dtype=np.bool_)
+                    sel = codes >= 0
+                    out[sel] = u_vals[codes[sel]]
+                    valid[sel] = u_ok[codes[sel]]
+                    cached = (out, valid)
+                self._cache["numeric_values"] = cached
+            return cached
+        if self.ctype == ColumnType.DOUBLE or self.ctype == ColumnType.DECIMAL:
+            # constructors fill null slots with 0.0, so the backing array
+            # is directly usable under mask algebra (0 * mask == 0, no NaN
+            # poisoning) — no per-batch materialization
+            return self.values, self.valid
+        cached = self._cache.get("numeric_values")
+        if cached is None:
+            cached = (
+                np.where(self.valid, self.values.astype(np.float64), 0.0),
+                self.valid,
+            )
+            self._cache["numeric_values"] = cached
+        return cached
 
     def as_float(self) -> np.ndarray:
         """Values as float64; null/unparseable slots = 0.0 (mask separately
@@ -102,17 +138,36 @@ class Column:
         """Dictionary-encode: (codes int64, uniques). Null rows get code -1.
 
         The group-by building block: arbitrary keys become dense integer
-        codes the device can bincount/segment-reduce over.
+        codes the device can bincount/segment-reduce over. Memoized per
+        Column instance — every string analyzer on a batch shares one
+        encode.
         """
+        cached = self._cache.get("dict_encode")
+        if cached is not None:
+            return cached
+        parent = getattr(self, "_parent", None)
+        if parent is not None:
+            p, start, stop = parent
+            p_codes, p_uniques = p.dict_encode()
+            out = (p_codes[start:stop], p_uniques)
+            self._cache["dict_encode"] = out
+            return out
         if not self.valid.any():
-            return np.full(len(self.values), -1, dtype=np.int64), np.array([], dtype=object)
+            out = (
+                np.full(len(self.values), -1, dtype=np.int64),
+                np.array([], dtype=object),
+            )
+            self._cache["dict_encode"] = out
+            return out
         vals = self.values[self.valid]
         if self.ctype == ColumnType.STRING:
             vals = vals.astype(str)
         uniques, inv = np.unique(vals, return_inverse=True)
         codes = np.full(len(self.values), -1, dtype=np.int64)
         codes[self.valid] = inv
-        return codes, uniques
+        out = (codes, uniques)
+        self._cache["dict_encode"] = out
+        return out
 
 
 def _infer_type(values: Sequence) -> ColumnType:
@@ -216,6 +271,11 @@ class Table:
                         arr[~v] = ""
                 else:
                     v = np.ones(len(arr), dtype=np.bool_)
+            elif ctype == ColumnType.DOUBLE:
+                # NaN == NULL under this engine; enforce the neutral-fill
+                # contract even when the caller supplies the mask
+                v = np.asarray(v, dtype=np.bool_) & ~np.isnan(arr)
+                arr = np.where(v, arr, 0.0)
             cols.append(Column(name, ctype, arr, np.asarray(v, dtype=np.bool_)))
         return Table(cols)
 
@@ -363,7 +423,9 @@ class Table:
 
     def batches(self, batch_size: int) -> Iterator["Table"]:
         """Stream fixed-size row slices (the unit shipped to device)."""
-        if self._num_rows == 0:
+        if self._num_rows <= batch_size:
+            # single batch: yield self so per-Column caches (dict codes,
+            # parsed numerics) are shared across every pass over this table
             yield self
             return
         for start in range(0, self._num_rows, batch_size):

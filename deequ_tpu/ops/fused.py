@@ -147,6 +147,19 @@ class FusedScanPass:
 
         total: Optional[List[Any]] = None
         host_states: List[Any] = [None] * len(host_analyzers)
+        pending = None  # previous batch's device outputs, copy in flight
+
+        def fold(device_out):
+            nonlocal total
+            batch_aggs = [_to_f64(t) for t in jax.device_get(device_out)]
+            if total is None:
+                total = batch_aggs
+            else:
+                total = [
+                    a.merge_agg(t, b, np)
+                    for a, t, b in zip(analyzers, total, batch_aggs)
+                ]
+
         for batch in table.batches(self.batch_size):
             if fused is not None:
                 padded = _pad_size(batch.num_rows, self.batch_size)
@@ -160,8 +173,16 @@ class FusedScanPass:
                         inputs[key] = jnp.asarray(arr.astype(dtype))
                 runtime.record_launch()
                 # async dispatch: the device crunches this batch while the
-                # host runs the host-reduced analyzers below
+                # host folds the previous batch and runs host reducers
                 device_out = fused(inputs)
+                jax.tree_util.tree_map(
+                    lambda x: x.copy_to_host_async(), device_out
+                )
+                if pending is not None:
+                    # previous batch's copy has had a full batch of device
+                    # work to complete: the get below doesn't stall
+                    fold(pending)
+                pending = device_out
             for j, reducer in enumerate(host_reducers):
                 partial = reducer(batch)
                 if partial is not None:
@@ -170,13 +191,6 @@ class FusedScanPass:
                         if host_states[j] is None
                         else host_states[j].merge(partial)
                     )
-            if fused is not None:
-                batch_aggs = [_to_f64(t) for t in jax.device_get(device_out)]
-                if total is None:
-                    total = batch_aggs
-                else:
-                    total = [
-                        a.merge_agg(t, b, np)
-                        for a, t, b in zip(analyzers, total, batch_aggs)
-                    ]
+        if pending is not None:
+            fold(pending)
         return (total if total is not None else []), host_states

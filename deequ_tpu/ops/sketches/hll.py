@@ -3,7 +3,8 @@
 Replaces the reference's imperative JVM kernel
 (reference: catalyst/StatefulHyperloglogPlus.scala:31-298) with a split
 design: the host vectorizes hashing (numpy xxhash64 for 8-byte values,
-blake2b for variable-length strings), the device owns the register
+a vectorized xxhash-style mix over unique strings — ops/strings.py), the
+device owns the register
 scatter-max (`zeros.at[idx].max(rank)`), and merging is elementwise max —
 which on a mesh is literally `lax.pmax` over the register array.
 
@@ -18,9 +19,6 @@ rsd=0.05 (divergence documented in BASELINE.md terms).
 """
 
 from __future__ import annotations
-
-import hashlib
-from typing import Optional
 
 import numpy as np
 
@@ -42,31 +40,47 @@ def _rotl(x: np.ndarray, r: int) -> np.ndarray:
     return (x << r) | (x >> (np.uint64(64) - r))
 
 
+def _rotl_inplace(x: np.ndarray, r: int, scratch: np.ndarray) -> np.ndarray:
+    """x <- rotl(x, r) using a preallocated scratch buffer."""
+    np.right_shift(x, np.uint64(64 - r), out=scratch)
+    np.left_shift(x, np.uint64(r), out=x)
+    np.bitwise_or(x, scratch, out=x)
+    return x
+
+
 def xxhash64_u64(values: np.ndarray, seed: np.uint64 = SEED) -> np.ndarray:
     """Vectorized xxhash64 of 8-byte values (the hot path for numeric
-    columns; one fused numpy pipeline, no Python loop)."""
+    columns). In-place numpy ops: two buffers total, no per-op
+    temporaries — this runs at memory speed over billions of rows."""
     with np.errstate(over="ignore"):
         v = values.view(np.uint64) if values.dtype == np.int64 else values.astype(np.uint64)
-        acc = seed + _PRIME5 + np.uint64(8)
-        k1 = _rotl(v * _PRIME2, 31) * _PRIME1
-        acc = _rotl(acc ^ k1, 27) * _PRIME1 + _PRIME4
-        acc ^= acc >> np.uint64(33)
+        acc = v * _PRIME2  # fresh buffer; v itself is never written
+        scratch = np.empty_like(acc)
+        _rotl_inplace(acc, 31, scratch)
+        acc *= _PRIME1
+        acc ^= seed + _PRIME5 + np.uint64(8)
+        _rotl_inplace(acc, 27, scratch)
+        acc *= _PRIME1
+        acc += _PRIME4
+        np.right_shift(acc, np.uint64(33), out=scratch)
+        acc ^= scratch
         acc *= _PRIME2
-        acc ^= acc >> np.uint64(29)
+        np.right_shift(acc, np.uint64(29), out=scratch)
+        acc ^= scratch
         acc *= _PRIME3
-        acc ^= acc >> np.uint64(32)
+        np.right_shift(acc, np.uint64(32), out=scratch)
+        acc ^= scratch
         return acc
 
 
 def hash_column(values: np.ndarray, valid: np.ndarray) -> np.ndarray:
     """64-bit hashes for the valid rows of a column (any dtype)."""
-    if values.dtype == object:
-        idx = np.nonzero(valid)[0]
-        out = np.empty(len(idx), dtype=np.uint64)
-        for j, i in enumerate(idx):
-            h = hashlib.blake2b(str(values[i]).encode("utf-8"), digest_size=8)
-            out[j] = np.frombuffer(h.digest(), dtype=np.uint64)[0]
-        return out
+    if values.dtype == object or values.dtype.kind == "U":
+        # strings: hash unique values only (vectorized), gather to rows
+        from deequ_tpu.ops.strings import hash_strings
+
+        uniques, inv = np.unique(values[valid].astype(str), return_inverse=True)
+        return hash_strings(uniques)[inv]
     if values.dtype == np.bool_:
         values = values.astype(np.int64)
     if np.issubdtype(values.dtype, np.floating):
@@ -80,13 +94,25 @@ def hash_column(values: np.ndarray, valid: np.ndarray) -> np.ndarray:
 
 def registers_from_hashes(hashes: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """(register index, rank) per hash: idx = top P bits, rank = 1 +
-    leading zeros of the remaining bits (capped for the 6-bit register)."""
+    leading zeros of the remaining bits (capped for the 6-bit register).
+
+    CLZ is vectorized via the f32 exponent of the top 32 bits (3 cheap
+    in-place ops instead of a f64 frexp): rank = 32 - floor(log2(top)).
+    The f32 mantissa rounds values just below a power of two upward with
+    probability ~2^-24 per value, making that rank 1 too small — far
+    below the sketch's rsd=0.05 noise floor. top==0 (probability 2^-32
+    per value) falls back to an exact scalar loop."""
     idx = (hashes >> np.uint64(64 - P)).astype(np.int32)
     rest = (hashes << np.uint64(P)) | (np.uint64(1) << np.uint64(P - 1))
-    # vectorized CLZ via the float64 exponent (the forced low bit keeps
-    # rest nonzero); clip guards the 2^-53 rounding-to-next-power edge
-    exponent = np.frexp(rest.astype(np.float64))[1]
-    rank = np.clip(64 - exponent + 1, 1, 64 - P + 1).astype(np.int32)
+    top = (rest >> np.uint64(32)).astype(np.uint32)
+    f_bits = top.astype(np.float32).view(np.uint32)
+    exponent = (f_bits >> np.uint32(23)).astype(np.int32) - 127
+    rank = 32 - exponent
+    zero_top = top == 0
+    if zero_top.any():
+        for i in np.nonzero(zero_top)[0]:
+            rank[i] = 65 - int(rest[i]).bit_length()
+    np.clip(rank, 1, 64 - P + 1, out=rank)
     return idx, rank
 
 

@@ -45,13 +45,38 @@ class KLLSketch:
 
     def update_batch(self, values: np.ndarray) -> "KLLSketch":
         values = np.asarray(values, dtype=np.float64)
-        if len(values) == 0:
+        m = len(values)
+        if m == 0:
             return self
-        self.n += len(values)
+        if m >= 8 * self.k:
+            return self._bulk_insert(values)
+        self.n += m
         self._buffer.append(values)
         buffered = sum(len(b) for b in self._buffer)
         if buffered >= self._capacity(0):
             self._flush()
+        return self
+
+    def _bulk_insert(self, values: np.ndarray) -> "KLLSketch":
+        """Large batch: ONE sort, then stride-2^L decimation straight into
+        level L — equivalent to L cascaded pairwise compactions collapsed
+        into a single step (one random offset instead of L independent
+        ones; the introduced rank error stays O(2^L), the same order as
+        the cascade's). Turns per-batch cost from ~2 sorts of m into one."""
+        m = len(values)
+        self.n += m
+        target_level = max(0, int(np.ceil(np.log2(m / (2.0 * self.k)))))
+        stride = 1 << target_level
+        sorted_vals = np.sort(values)
+        offset = int(self._rng.integers(0, stride))
+        promoted = sorted_vals[offset::stride]
+        while len(self.levels) <= target_level:
+            self.levels.append(np.empty(0, dtype=np.float64))
+        # both sides sorted: timsort exploits the runs (linear merge)
+        self.levels[target_level] = np.sort(
+            np.concatenate([self.levels[target_level], promoted]), kind="stable"
+        )
+        self._compress()
         return self
 
     def _flush(self) -> None:
@@ -82,7 +107,8 @@ class KLLSketch:
                 if level + 1 >= len(self.levels):
                     self.levels.append(np.empty(0, dtype=np.float64))
                 self.levels[level + 1] = np.sort(
-                    np.concatenate([self.levels[level + 1], promoted])
+                    np.concatenate([self.levels[level + 1], promoted]),
+                    kind="stable",  # two sorted runs: linear merge
                 )
                 self.levels[level] = keep
             level += 1

@@ -145,6 +145,19 @@ class DistributedScanPass:
         try:
             total: Optional[List[Any]] = None
             host_states: List[Any] = [None] * len(host_idx)
+            pending = None  # previous batch's device outputs, copy in flight
+
+            def fold(device_out):
+                nonlocal total
+                batch_aggs = [_to_f64(t) for t in jax.device_get(device_out)]
+                if total is None:
+                    total = batch_aggs
+                else:
+                    total = [
+                        a.merge_agg(t, b, np)
+                        for a, t, b in zip(device_analyzers, total, batch_aggs)
+                    ]
+
             for batch in table.batches(global_batch):
                 if fn is not None:
                     # pad to a multiple of n_devices (pow2 per device shard)
@@ -163,6 +176,12 @@ class DistributedScanPass:
                         inputs[key] = jax.device_put(arr, in_sharding[key])
                     runtime.record_launch()
                     device_out = fn(inputs)
+                    jax.tree_util.tree_map(
+                        lambda x: x.copy_to_host_async(), device_out
+                    )
+                    if pending is not None:
+                        fold(pending)
+                    pending = device_out
                 for j, reducer in enumerate(host_reducers):
                     partial = reducer(batch)
                     if partial is not None:
@@ -171,15 +190,8 @@ class DistributedScanPass:
                             if host_states[j] is None
                             else host_states[j].merge(partial)
                         )
-                if fn is not None:
-                    batch_aggs = [_to_f64(t) for t in jax.device_get(device_out)]
-                    if total is None:
-                        total = batch_aggs
-                    else:
-                        total = [
-                            a.merge_agg(t, b, np)
-                            for a, t, b in zip(device_analyzers, total, batch_aggs)
-                        ]
+            if pending is not None:
+                fold(pending)
             for i, analyzer, agg in zip(
                 device_idx, device_analyzers, total if total is not None else []
             ):

@@ -165,12 +165,12 @@ class RowLevelSchemaValidator:
                 # to `scale`, then marks rows whose integral part exceeds
                 # precision-scale digits as invalid
                 # (reference: schema/RowLevelSchemaValidator.scala:209-214)
-                factor = 10.0 ** definition.scale
-                rounded = np.sign(values) * np.floor(np.abs(values) * factor + 0.5) / factor
+                rounded = _round_half_up(col, values, valid, definition.scale)
                 int_digits = definition.precision - definition.scale
                 fits = valid & (np.abs(rounded) < 10.0 ** int_digits)
                 ok &= is_null | fits
-                cast_col = Column(definition.name, ColumnType.DECIMAL, rounded, fits)
+                cast_col = Column(definition.name, ColumnType.DECIMAL,
+                                  np.where(fits, rounded, 0.0), fits)
             elif isinstance(definition, TimestampColumnDefinition):
                 parsed, parse_ok = _parse_timestamps(col, definition.mask)
                 ok &= is_null | parse_ok
@@ -197,6 +197,31 @@ class RowLevelSchemaValidator:
         return RowLevelSchemaValidationResult(
             valid_rows, valid_rows.num_rows, invalid_rows, invalid_rows.num_rows
         )
+
+
+def _round_half_up(col: Column, values: np.ndarray, valid: np.ndarray,
+                   scale: int) -> np.ndarray:
+    """HALF_UP rounding to `scale`, matching java.math.BigDecimal: the
+    vectorized float path decides all rows except those whose scaled
+    fraction sits within float error of an exact half — those few are
+    re-rounded exactly with decimal.Decimal over the source text (e.g.
+    "9.995" is 9.994999…8 as a double, but BigDecimal("9.995") at scale 2
+    rounds HALF_UP to 10.00)."""
+    from decimal import ROUND_HALF_UP, Decimal, InvalidOperation
+
+    factor = 10.0 ** scale
+    scaled = np.abs(values) * factor
+    rounded = np.sign(values) * np.floor(scaled + 0.5) / factor
+    near_half = valid & (np.abs(np.abs(scaled - np.floor(scaled)) - 0.5) < 1e-6)
+    if near_half.any():
+        quantum = Decimal(1).scaleb(-scale)
+        for i in np.nonzero(near_half)[0]:
+            try:
+                exact = Decimal(str(col.values[i]).strip())
+            except InvalidOperation:
+                continue  # unparseable as decimal text: float verdict stands
+            rounded[i] = float(exact.quantize(quantum, rounding=ROUND_HALF_UP))
+    return rounded
 
 
 # Spark's integer cast accepts only an optional sign + decimal digits;
