@@ -17,9 +17,13 @@ our rows/s divided by that proxy; the build target is >=10.
 
 Knobs (env):
     BENCH_ROWS      rows to profile           (default 10_000_000)
-    BENCH_MODE      "profiler" | "scan"       (default "profiler")
+    BENCH_MODE      "profiler" | "scan" | "stream"  (default "profiler")
+                    stream = full profile over an on-disk Parquet file via
+                    Table.scan_parquet (out-of-core; constant host memory)
     BENCH_TIMED     timed repetitions          (default 1; steady-state
                      timing — compile happens during the warmup run)
+    BENCH_PARQUET   path for the stream-mode file (default /tmp/bench.parquet;
+                     reused if it already has BENCH_ROWS rows)
 """
 
 from __future__ import annotations
@@ -104,20 +108,63 @@ def run_scan(table):
     return results
 
 
+def write_parquet(n_rows: int, path: str, chunk: int = 2_000_000) -> None:
+    """Stream-generate the bench table to disk in chunks (bounded memory),
+    so stream mode can exceed host RAM."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    writer = None
+    done = 0
+    seed = 0
+    while done < n_rows:
+        rows = min(chunk, n_rows - done)
+        t = build_table(rows, seed=seed)
+        data = {}
+        for name, _ in t.schema:
+            col = t.column(name)
+            if col.values.dtype == object:
+                data[name] = pa.array(
+                    [v if ok else None for v, ok in zip(col.values, col.valid)]
+                )
+            else:
+                data[name] = pa.array(col.values, mask=~col.valid)
+        at = pa.table(data)
+        if writer is None:
+            writer = pq.ParquetWriter(path, at.schema)
+        writer.write_table(at)
+        done += rows
+        seed += 1
+    if writer is not None:
+        writer.close()
+
+
 def main() -> None:
     n_rows = int(os.environ.get("BENCH_ROWS", "10000000"))
     mode = os.environ.get("BENCH_MODE", "profiler")
     reps = max(1, int(os.environ.get("BENCH_TIMED", "1")))
 
     t_gen = time.perf_counter()
-    table = build_table(n_rows)
+    if mode == "stream":
+        import pyarrow.parquet as pq
+
+        from deequ_tpu.data.table import Table
+
+        path = os.environ.get("BENCH_PARQUET", "/tmp/bench.parquet")
+        if not (
+            os.path.exists(path) and pq.ParquetFile(path).metadata.num_rows == n_rows
+        ):
+            write_parquet(n_rows, path)
+        table = Table.scan_parquet(path)
+    else:
+        table = build_table(n_rows)
     gen_s = time.perf_counter() - t_gen
 
-    run = run_profiler if mode == "profiler" else run_scan
+    run = run_scan if mode == "scan" else run_profiler
     baseline = (
-        SPARK_LOCAL_PROFILE_ROWS_PER_SEC
-        if mode == "profiler"
-        else SPARK_LOCAL_SCAN_ROWS_PER_SEC
+        SPARK_LOCAL_SCAN_ROWS_PER_SEC
+        if mode == "scan"
+        else SPARK_LOCAL_PROFILE_ROWS_PER_SEC
     )
 
     # warmup: compiles every (analyzer-set, padded-shape) program

@@ -34,41 +34,97 @@ from deequ_tpu.data.table import ColumnType, Table
 # ---------------------------------------------------------------------------
 
 
-@dataclass
 class FrequenciesAndNumRows(State):
     """Group keys + counts + overall #rows
-    (reference: GroupingAnalyzers.scala:124-157)."""
+    (reference: GroupingAnalyzers.scala:124-157).
 
-    columns: List[str]
-    keys: List[Tuple]  # one tuple of group-key values per group
-    counts: np.ndarray  # int64, aligned with keys
-    num_rows: int
+    Keys are stored columnar (one object array per grouping column,
+    aligned with ``counts``) so merges stay vectorized; ``keys`` exposes
+    the row-tuple view lazily for consumers that want it.
+    """
+
+    __slots__ = ("columns", "key_columns", "counts", "num_rows", "_keys")
+
+    def __init__(self, columns, keys, counts, num_rows: int):
+        """`keys` is either a list of per-group tuples or a list of
+        per-COLUMN arrays (len == len(columns)); both are accepted so
+        construction sites build whichever is natural."""
+        self.columns: List[str] = list(columns)
+        counts = np.asarray(counts, dtype=np.int64)
+        if len(keys) == len(self.columns) and all(
+            isinstance(k, np.ndarray) for k in keys
+        ):
+            self.key_columns = [np.asarray(k, dtype=object) for k in keys]
+        else:
+            n = len(keys)
+            self.key_columns = [
+                np.array([k[j] for k in keys], dtype=object)
+                for j in range(len(self.columns))
+            ]
+            assert all(len(kc) == n for kc in self.key_columns)
+        self.counts = counts
+        self.num_rows = int(num_rows)
+        self._keys: Optional[List[Tuple]] = None
+
+    @property
+    def keys(self) -> List[Tuple]:
+        if self._keys is None:
+            self._keys = (
+                list(zip(*[kc.tolist() for kc in self.key_columns]))
+                if len(self.counts)
+                else []
+            )
+        return self._keys
 
     @property
     def num_groups(self) -> int:
-        return len(self.keys)
+        return len(self.counts)
 
     def merge(self, other: "FrequenciesAndNumRows") -> "FrequenciesAndNumRows":
-        other_keys = other.keys
+        other_cols = other.key_columns
         if self.columns != other.columns:
-            # align by column name (the dict analogue of the reference's
-            # name-based outer join); declared order may differ from the
-            # runner's sorted sharing order
+            # align by column name (the columnar analogue of the
+            # reference's name-based outer join); declared order may
+            # differ from the runner's sorted sharing order
             if sorted(self.columns) != sorted(other.columns):
                 raise ValueError(
                     f"cannot merge frequencies over {self.columns} with {other.columns}"
                 )
-            perm = [other.columns.index(c) for c in self.columns]
-            other_keys = [tuple(k[i] for i in perm) for k in other.keys]
-        combined: Dict[Tuple, int] = {}
-        for key, count in zip(self.keys, self.counts):
-            combined[key] = combined.get(key, 0) + int(count)
-        for key, count in zip(other_keys, other.counts):
-            combined[key] = combined.get(key, 0) + int(count)
-        keys = list(combined.keys())
-        counts = np.array([combined[k] for k in keys], dtype=np.int64)
+            other_cols = [
+                other.key_columns[other.columns.index(c)] for c in self.columns
+            ]
+        # C-hash group-by over the concatenated key columns — the
+        # vectorized form of the reference's outer join + count sum
+        # (GroupingAnalyzers.scala:128-148); no Python loop over groups
+        import pandas as pd
+
+        frame = {
+            f"k{j}": np.concatenate([self.key_columns[j], other_cols[j]])
+            for j in range(len(self.columns))
+        }
+        frame["__count"] = np.concatenate([self.counts, other.counts])
+        grouped = (
+            pd.DataFrame(frame)
+            .groupby(
+                [f"k{j}" for j in range(len(self.columns))],
+                sort=False,
+                dropna=False,  # NaN/None group keys are real groups
+            )["__count"]
+            .sum()
+        )
+        index = grouped.index
+        if len(self.columns) == 1:
+            key_columns = [index.to_numpy(dtype=object)]
+        else:
+            key_columns = [
+                index.get_level_values(j).to_numpy(dtype=object)
+                for j in range(len(self.columns))
+            ]
         return FrequenciesAndNumRows(
-            list(self.columns), keys, counts, self.num_rows + other.num_rows
+            list(self.columns),
+            key_columns,
+            grouped.to_numpy(dtype=np.int64),
+            self.num_rows + other.num_rows,
         )
 
     def __eq__(self, other) -> bool:
@@ -79,6 +135,12 @@ class FrequenciesAndNumRows(State):
             and self.num_rows == other.num_rows
             and dict(zip(self.keys, self.counts.tolist()))
             == dict(zip(other.keys, other.counts.tolist()))
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"FrequenciesAndNumRows({self.columns}, groups={self.num_groups}, "
+            f"num_rows={self.num_rows})"
         )
 
 
@@ -97,14 +159,49 @@ def _column_key_values(col) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def compute_frequencies(
-    data: Table, grouping_columns: Sequence[str], num_rows: Optional[int] = None
+    data: Table,
+    grouping_columns: Sequence[str],
+    num_rows: Optional[int] = None,
+    mesh=None,
 ) -> FrequenciesAndNumRows:
     """reference: GroupingAnalyzers.scala:53-80. Rows where ANY grouping
-    column is NULL are excluded from groups; num_rows counts all rows."""
+    column is NULL are excluded from groups; num_rows counts all rows.
+
+    Streaming sources are folded batch-by-batch with the vectorized
+    state merge — bounded host memory at O(#groups), never O(#rows).
+    With a mesh, the count aggregation runs row-sharded on the devices
+    (psum merge); the host keeps dict-encode and key bookkeeping."""
     from deequ_tpu.ops import runtime
 
     runtime.record_group_pass(",".join(grouping_columns))
 
+    if getattr(data, "is_streaming", False):
+        state: Optional[FrequenciesAndNumRows] = None
+        for batch in data.batches(getattr(data, "batch_rows", 1 << 22)):
+            partial = _frequencies_of_batch(batch, grouping_columns, mesh)
+            state = partial if state is None else state.merge(partial)
+        if state is None:
+            state = FrequenciesAndNumRows(
+                list(grouping_columns), [], np.array([], dtype=np.int64), 0
+            )
+        if num_rows is not None:
+            state.num_rows = num_rows
+        return state
+
+    state = _frequencies_of_batch(data, grouping_columns, mesh)
+    if num_rows is not None:
+        state.num_rows = num_rows
+    return state
+
+
+# raveled group-code spaces larger than this spill to the host np.unique
+# path (the analogue of the reference's cache-grouped-data escape hatch)
+_MAX_DEVICE_BINS = 1 << 20
+
+
+def _frequencies_of_batch(
+    data: Table, grouping_columns: Sequence[str], mesh=None
+) -> FrequenciesAndNumRows:
     cols = [data.column(name) for name in grouping_columns]
     valid = np.ones(data.num_rows, dtype=np.bool_)
     for col in cols:
@@ -113,22 +210,38 @@ def compute_frequencies(
     encoded = [_column_key_values(col) for col in cols]
     dims = [max(len(u), 1) for _, u in encoded]
 
-    if valid.any():
-        code_arrays = [np.where(valid, c, 0) for c, _ in encoded]
-        combined = np.ravel_multi_index(code_arrays, dims)[valid]
-        unique_codes, counts = np.unique(combined, return_counts=True)
-        unraveled = np.unravel_index(unique_codes, dims)
-        keys = [
-            tuple(encoded[j][1][unraveled[j][i]] for j in range(len(cols)))
-            for i in range(len(unique_codes))
-        ]
-        counts = counts.astype(np.int64)
-    else:
-        keys = []
-        counts = np.array([], dtype=np.int64)
+    if not valid.any():
+        return FrequenciesAndNumRows(
+            list(grouping_columns),
+            [np.array([], dtype=object) for _ in cols],
+            np.array([], dtype=np.int64),
+            data.num_rows,
+        )
 
-    total = num_rows if num_rows is not None else data.num_rows
-    return FrequenciesAndNumRows(list(grouping_columns), keys, counts, total)
+    code_arrays = [np.where(valid, c, 0) for c, _ in encoded]
+    combined_all = np.ravel_multi_index(code_arrays, dims)
+    total_bins = int(np.prod(dims))
+
+    if mesh is not None and total_bins <= _MAX_DEVICE_BINS:
+        from deequ_tpu.parallel.distributed import sharded_bincount
+
+        combined_signed = np.where(valid, combined_all, -1)
+        bin_counts = sharded_bincount(combined_signed, total_bins, mesh)
+        unique_codes = np.nonzero(bin_counts)[0]
+        counts = bin_counts[unique_codes]
+    else:
+        combined = combined_all[valid]
+        unique_codes, counts = np.unique(combined, return_counts=True)
+        counts = counts.astype(np.int64)
+
+    unraveled = np.unravel_index(unique_codes, dims)
+    # per-column gather of group-key values: one fancy-index per column,
+    # no Python loop over groups
+    key_columns = [encoded[j][1][unraveled[j]] for j in range(len(cols))]
+
+    return FrequenciesAndNumRows(
+        list(grouping_columns), key_columns, counts, data.num_rows
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -346,12 +459,12 @@ class MutualInformation(FrequencyBasedAnalyzer):
         # state columns may be sorted differently than self.columns
         ia = state.columns.index(self.columns[0])
         ib = state.columns.index(self.columns[1])
-        keys_a = [k[ia] for k in state.keys]
-        keys_b = [k[ib] for k in state.keys]
+        keys_a = state.key_columns[ia]
+        keys_b = state.key_columns[ib]
         counts = state.counts.astype(np.float64)
 
-        _, codes_a = np.unique(np.array(keys_a, dtype=object), return_inverse=True)
-        _, codes_b = np.unique(np.array(keys_b, dtype=object), return_inverse=True)
+        _, codes_a = np.unique(keys_a.astype(str), return_inverse=True)
+        _, codes_b = np.unique(keys_b.astype(str), return_inverse=True)
         marg_a = np.bincount(codes_a, weights=counts)
         marg_b = np.bincount(codes_b, weights=counts)
 

@@ -88,6 +88,15 @@ class Histogram(Analyzer):
         from deequ_tpu.ops import runtime
 
         runtime.record_group_pass(f"histogram:{self.column}")
+        if getattr(table, "is_streaming", False):
+            state: Optional[FrequenciesAndNumRows] = None
+            for batch in table.batches(getattr(table, "batch_rows", 1 << 22)):
+                partial = self._state_of_batch(batch)
+                state = partial if state is None else state.merge(partial)
+            return state
+        return self._state_of_batch(table)
+
+    def _state_of_batch(self, table: Table) -> FrequenciesAndNumRows:
         col = table.column(self.column)
         if self.binning_udf is None:
             # vectorized fast path: group on dictionary codes, stringify
@@ -140,7 +149,7 @@ class Histogram(Analyzer):
             order = np.argsort(state.counts, kind="stable")[::-1][: self.max_detail_bins]
             details = {}
             for i in order:
-                value = state.keys[i][0]
+                value = state.key_columns[0][i]
                 absolute = int(state.counts[i])
                 details[value] = DistributionValue(
                     absolute, absolute / state.num_rows

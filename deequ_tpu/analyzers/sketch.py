@@ -196,14 +196,17 @@ def _relative_error_param_check(relative_error: float) -> Callable[[Table], None
     return check
 
 
-_BATCH_SEED_COUNTER = [0]
+import itertools
+
+# itertools.count.__next__ is atomic under the GIL: shard reducers may
+# run concurrently in the distributed pass's thread pool
+_BATCH_SEED_COUNTER = itertools.count(1)
 
 
 def _next_batch_seed() -> int:
     """Distinct seed per batch sketch: KLL's error bound needs independent
     compaction offsets across merged partials."""
-    _BATCH_SEED_COUNTER[0] += 1
-    return _BATCH_SEED_COUNTER[0]
+    return next(_BATCH_SEED_COUNTER)
 
 
 class _QuantileAnalyzerBase(ScanShareableAnalyzer):

@@ -257,7 +257,7 @@ class FileSystemStateProvider(StateLoader, StatePersister):
         os.makedirs(directory, exist_ok=True)
 
         columns = {
-            name: [key[i] for key in state.keys]
+            name: state.key_columns[i].tolist()
             for i, name in enumerate(state.columns)
         }
         columns[COUNT_COL] = [int(c) for c in state.counts]
@@ -287,9 +287,10 @@ class FileSystemStateProvider(StateLoader, StatePersister):
         with open(self._path(identifier, "-num_rows.bin"), "rb") as f:
             (num_rows,) = struct.unpack(">q", f.read())
         counts = np.asarray(table.column(COUNT_COL).to_pylist(), dtype=np.int64)
-        key_columns = [table.column(c).to_pylist() for c in columns]
-        keys = [tuple(col[i] for col in key_columns) for i in range(len(counts))]
-        return FrequenciesAndNumRows(columns, keys, counts, int(num_rows))
+        key_columns = [
+            np.array(table.column(c).to_pylist(), dtype=object) for c in columns
+        ]
+        return FrequenciesAndNumRows(columns, key_columns, counts, int(num_rows))
 
 
 def _serialize_kll(digest) -> bytes:

@@ -159,6 +159,21 @@ class Column:
             )
             self._cache["dict_encode"] = out
             return out
+        arrow_arr = self._cache.get("arrow")
+        if arrow_arr is not None:
+            # arrow-backed string column: hash-based C dictionary encode
+            encoded = arrow_arr.dictionary_encode()
+            codes = (
+                encoded.indices.fill_null(-1)
+                .to_numpy(zero_copy_only=False)
+                .astype(np.int64)
+            )
+            uniques = encoded.dictionary.to_numpy(zero_copy_only=False)
+            if uniques.dtype != object:
+                uniques = uniques.astype(object)
+            out = (codes, uniques)
+            self._cache["dict_encode"] = out
+            return out
         vals = self.values[self.valid]
         if self.ctype == ColumnType.STRING:
             vals = vals.astype(str)
@@ -261,8 +276,8 @@ class Table:
                 arr = arr.astype(object)
             v = valid.get(name)
             if v is None:
-                if ctype == ColumnType.DOUBLE:
-                    v = ~np.isnan(arr)
+                if ctype in (ColumnType.DOUBLE, ColumnType.DECIMAL):
+                    v = ~np.isnan(np.asarray(arr, dtype=np.float64))
                     arr = np.where(v, arr, 0.0)
                 elif ctype == ColumnType.STRING:
                     v = np.array([x is not None for x in arr], dtype=np.bool_)
@@ -271,10 +286,12 @@ class Table:
                         arr[~v] = ""
                 else:
                     v = np.ones(len(arr), dtype=np.bool_)
-            elif ctype == ColumnType.DOUBLE:
+            elif ctype in (ColumnType.DOUBLE, ColumnType.DECIMAL):
                 # NaN == NULL under this engine; enforce the neutral-fill
                 # contract even when the caller supplies the mask
-                v = np.asarray(v, dtype=np.bool_) & ~np.isnan(arr)
+                v = np.asarray(v, dtype=np.bool_) & ~np.isnan(
+                    np.asarray(arr, dtype=np.float64)
+                )
                 arr = np.where(v, arr, 0.0)
             cols.append(Column(name, ctype, arr, np.asarray(v, dtype=np.bool_)))
         return Table(cols)
@@ -361,6 +378,17 @@ class Table:
                 cols.append(
                     Column(name, ColumnType.TIMESTAMP, vals.astype("datetime64[us]"), valid)
                 )
+            elif pa.types.is_string(t) or pa.types.is_large_string(t):
+                vals = arr.to_numpy(zero_copy_only=False)
+                if vals.dtype != object:
+                    vals = vals.astype(object)
+                if not valid.all():
+                    vals[~valid] = ""
+                col = Column(name, ColumnType.STRING, vals, valid)
+                # keep the arrow array: dict_encode uses its C hash-based
+                # dictionary_encode instead of a sort-based np.unique
+                col._cache["arrow"] = arr
+                cols.append(col)
             else:
                 py = arr.to_pylist()
                 vals = np.empty(len(py), dtype=object)
@@ -374,6 +402,20 @@ class Table:
         import pyarrow.parquet as pq
 
         return Table.from_arrow(pq.read_table(path, columns=columns))
+
+    @staticmethod
+    def scan_parquet(
+        path: str,
+        columns: Optional[List[str]] = None,
+        batch_rows: int = 1 << 22,
+    ):
+        """Out-of-core scan: a streaming source every pass can consume
+        (bounded host memory; prefetch thread overlaps decode with device
+        compute). Use instead of `from_parquet` when the table exceeds
+        host RAM."""
+        from deequ_tpu.data.source import ParquetSource
+
+        return ParquetSource(path, columns=columns, batch_rows=batch_rows)
 
     # -- schema / access ----------------------------------------------------
 

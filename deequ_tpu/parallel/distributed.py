@@ -26,7 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deequ_tpu.analyzers.base import ScanShareableAnalyzer
 from deequ_tpu.data.table import Table
 from deequ_tpu.ops import runtime
-from deequ_tpu.ops.fused import AnalyzerRunResult, _pad_size, _to_f64
+from deequ_tpu.ops.fused import AnalyzerRunResult, PipelinedAggFold, _pad_size
 
 DATA_AXIS = "data"
 
@@ -101,6 +101,18 @@ class DistributedScanPass:
         self.mesh = mesh if mesh is not None else data_mesh()
         self.axis_name = axis_name
         self.batch_size_per_device = batch_size_per_device
+        self._executor = None
+
+    def _pool(self):
+        if self._executor is None:
+            import os
+            from concurrent.futures import ThreadPoolExecutor
+
+            workers = min(
+                self.mesh.shape[self.axis_name], os.cpu_count() or 1
+            )
+            self._executor = ThreadPoolExecutor(max_workers=workers)
+        return self._executor
 
     def run(self, table: Table) -> List[AnalyzerRunResult]:
         device_analyzers: List[ScanShareableAnalyzer] = []
@@ -143,20 +155,8 @@ class DistributedScanPass:
         )
 
         try:
-            total: Optional[List[Any]] = None
             host_states: List[Any] = [None] * len(host_idx)
-            pending = None  # previous batch's device outputs, copy in flight
-
-            def fold(device_out):
-                nonlocal total
-                batch_aggs = [_to_f64(t) for t in jax.device_get(device_out)]
-                if total is None:
-                    total = batch_aggs
-                else:
-                    total = [
-                        a.merge_agg(t, b, np)
-                        for a, t, b in zip(device_analyzers, total, batch_aggs)
-                    ]
+            fold = PipelinedAggFold(device_analyzers)
 
             for batch in table.batches(global_batch):
                 if fn is not None:
@@ -175,25 +175,39 @@ class DistributedScanPass:
                             arr = arr.astype(dtype)
                         inputs[key] = jax.device_put(arr, in_sharding[key])
                     runtime.record_launch()
-                    device_out = fn(inputs)
-                    jax.tree_util.tree_map(
-                        lambda x: x.copy_to_host_async(), device_out
-                    )
-                    if pending is not None:
-                        fold(pending)
-                    pending = device_out
-                for j, reducer in enumerate(host_reducers):
-                    partial = reducer(batch)
-                    if partial is not None:
-                        host_states[j] = (
-                            partial
-                            if host_states[j] is None
-                            else host_states[j].merge(partial)
+                    fold.submit(fn(inputs))
+                if host_reducers:
+                    # host-reduced analyzers (quantile sketches) run on
+                    # per-device row shards in a thread pool — numpy sorts
+                    # release the GIL, so shards reduce in parallel, and
+                    # the per-shard partial states merge like any other
+                    # semigroup state
+                    shard_bounds = [
+                        (s, min(s + self.batch_size_per_device, batch.num_rows))
+                        for s in range(
+                            0, batch.num_rows, self.batch_size_per_device
                         )
-            if pending is not None:
-                fold(pending)
+                    ]
+                    shards = (
+                        [batch.slice(a, b) for a, b in shard_bounds]
+                        if len(shard_bounds) > 1
+                        else [batch]
+                    )
+                    for j, reducer in enumerate(host_reducers):
+                        partials = (
+                            list(self._pool().map(reducer, shards))
+                            if len(shards) > 1
+                            else [reducer(shards[0])]
+                        )
+                        for partial in partials:
+                            if partial is not None:
+                                host_states[j] = (
+                                    partial
+                                    if host_states[j] is None
+                                    else host_states[j].merge(partial)
+                                )
             for i, analyzer, agg in zip(
-                device_idx, device_analyzers, total if total is not None else []
+                device_idx, device_analyzers, fold.finish()
             ):
                 results[i] = AnalyzerRunResult(
                     analyzer, state=analyzer.state_from_aggregates(agg)
@@ -205,6 +219,52 @@ class DistributedScanPass:
                 results[i] = AnalyzerRunResult(self.analyzers[i], error=e)
 
         return [results[i] for i in range(len(self.analyzers))]
+
+
+_BINCOUNT_CACHE: Dict[Any, Any] = {}
+
+
+def sharded_bincount(
+    codes: np.ndarray, nbins: int, mesh: Mesh, axis_name: str = DATA_AXIS
+) -> np.ndarray:
+    """Row-sharded group counting: each device scatter-adds its shard of
+    dense group codes into a fixed-size count table, merged in-graph with
+    psum over the mesh — the device form of the reference's
+    groupBy().agg(count) shuffle (reference: GroupingAnalyzers.scala:67-72).
+
+    `codes` may contain -1 (null group) — counted into a trash bin and
+    dropped. Returns int64 counts[nbins].
+    """
+    n_devices = mesh.shape[axis_name]
+    nbins_p = _pad_size(nbins + 1, 1 << 30)
+    per_dev = _pad_size(-(-len(codes) // n_devices), 1 << 30)
+    padded_rows = per_dev * n_devices
+
+    full = np.full(padded_rows, nbins, dtype=np.int64)  # pad/null -> trash
+    np.copyto(full[: len(codes)], np.where(codes >= 0, codes, nbins))
+
+    key = (padded_rows, nbins_p, mesh, axis_name)
+    fn = _BINCOUNT_CACHE.get(key)
+    if fn is None:
+
+        def per_device(c):
+            counts = jnp.zeros(nbins_p, dtype=jnp.int32).at[c].add(1)
+            return jax.lax.psum(counts, axis_name)
+
+        fn = jax.jit(
+            jax.shard_map(
+                per_device,
+                mesh=mesh,
+                in_specs=(P(axis_name),),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+        _BINCOUNT_CACHE[key] = fn
+    runtime.record_launch()
+    sharding = NamedSharding(mesh, P(axis_name))
+    counts = np.asarray(fn(jax.device_put(full, sharding)))
+    return counts[:nbins].astype(np.int64)
 
 
 def run_distributed_analysis(

@@ -71,6 +71,8 @@ class ColumnProfiler:
         reuse_existing_results_for_key=None,
         fail_if_results_missing: bool = False,
         save_in_metrics_repository_using_key=None,
+        engine: str = "auto",
+        mesh=None,
     ) -> ColumnProfiles:
         """reference: ColumnProfiler.scala:81-188."""
         relevant = (
@@ -91,7 +93,11 @@ class ColumnProfiler:
             if data.column(name).ctype == ColumnType.STRING:
                 analyzers_pass1.append(DataType(name))
 
-        builder = AnalysisRunner.on_data(data).add_analyzers(analyzers_pass1)
+        builder = (
+            AnalysisRunner.on_data(data)
+            .add_analyzers(analyzers_pass1)
+            .with_engine(engine, mesh)
+        )
         if metrics_repository is not None:
             builder = builder.use_repository(metrics_repository)
             if reuse_existing_results_for_key is not None:
@@ -129,7 +135,10 @@ class ColumnProfiler:
                 ]
             )
         results_pass2 = (
-            AnalysisRunner.on_data(casted_data).add_analyzers(analyzers_pass2).run()
+            AnalysisRunner.on_data(casted_data)
+            .add_analyzers(analyzers_pass2)
+            .with_engine(engine, mesh)
+            .run()
             if analyzers_pass2
             else None
         )
@@ -196,18 +205,34 @@ def _cast_numeric_string_columns(
     columns: Sequence[str], data: Table, stats: GenericColumnStatistics
 ) -> Table:
     """String columns inferred Integral/Fractional are cast for pass 2
-    (reference: ColumnProfiler.scala:329-339, 399-417)."""
-    out = data
-    for name in columns:
-        if name not in stats.inferred_types:
-            continue
-        inferred = stats.inferred_types[name]
-        if inferred not in (DataTypeInstances.INTEGRAL, DataTypeInstances.FRACTIONAL):
-            continue
-        col = data.column(name)
-        values, valid = col.numeric_values()
-        out = out.with_column(Column(name, ColumnType.DOUBLE, values, valid))
-    return out
+    (reference: ColumnProfiler.scala:329-339, 399-417). On a streaming
+    source the cast is a lazy per-batch transform."""
+    to_cast = [
+        name
+        for name in columns
+        if stats.inferred_types.get(name)
+        in (DataTypeInstances.INTEGRAL, DataTypeInstances.FRACTIONAL)
+    ]
+    if not to_cast:
+        return data
+
+    def cast_batch(batch: Table) -> Table:
+        out = batch
+        for name in to_cast:
+            col = batch.column(name)
+            values, valid = col.numeric_values()
+            out = out.with_column(Column(name, ColumnType.DOUBLE, values, valid))
+        return out
+
+    if getattr(data, "is_streaming", False):
+        from deequ_tpu.data.source import MappedSource
+
+        return MappedSource(
+            data,
+            cast_batch,
+            schema_overrides=[(name, ColumnType.DOUBLE) for name in to_cast],
+        )
+    return cast_batch(data)
 
 
 @dataclass
@@ -268,30 +293,49 @@ def _compute_histograms(
     data: Table, target_columns: Sequence[str], num_records: int
 ) -> Dict[str, Distribution]:
     """One exact counting pass over all target columns
-    (reference: ColumnProfiler.scala:523-565)."""
+    (reference: ColumnProfiler.scala:523-565). Streaming sources fold
+    per-batch count maps — host memory is O(#distinct), and only
+    low-cardinality columns are targeted here."""
     if not target_columns:
         return {}
     from deequ_tpu.ops import runtime
 
     runtime.record_group_pass("profiler-histograms:" + ",".join(target_columns))
+
+    totals: Dict[str, Dict[str, int]] = {name: {} for name in target_columns}
+    null_counts: Dict[str, int] = {name: 0 for name in target_columns}
+
+    def accumulate(batch: Table) -> None:
+        for name in target_columns:
+            col = batch.column(name)
+            codes, uniques = col.dict_encode()
+            counts = np.bincount(codes + 1, minlength=len(uniques) + 1)
+            null_counts[name] += int(counts[0])
+            bucket = totals[name]
+            for i, unique in enumerate(uniques):
+                count = int(counts[i + 1])
+                if count == 0:
+                    continue
+                if col.ctype == ColumnType.BOOLEAN:
+                    key = "true" if unique else "false"
+                else:
+                    key = str(unique)
+                bucket[key] = bucket.get(key, 0) + count
+
+    if getattr(data, "is_streaming", False):
+        for batch in data.batches(getattr(data, "batch_rows", 1 << 22)):
+            accumulate(batch)
+    else:
+        accumulate(data)
+
     histograms: Dict[str, Distribution] = {}
     for name in target_columns:
-        col = data.column(name)
-        codes, uniques = col.dict_encode()
-        counts = np.bincount(codes + 1, minlength=len(uniques) + 1)
         values: Dict[str, DistributionValue] = {}
-        if counts[0] > 0:
+        if null_counts[name] > 0:
             values["NullValue"] = DistributionValue(
-                int(counts[0]), counts[0] / num_records
+                null_counts[name], null_counts[name] / num_records
             )
-        for i, unique in enumerate(uniques):
-            count = int(counts[i + 1])
-            if count == 0:
-                continue
-            if col.ctype == ColumnType.BOOLEAN:
-                key = "true" if unique else "false"
-            else:
-                key = str(unique)
+        for key, count in totals[name].items():
             values[key] = DistributionValue(count, count / num_records)
         histograms[name] = Distribution(values, number_of_bins=len(values))
     return histograms

@@ -35,6 +35,14 @@ class ColumnProfilerRunBuilder:
         self._save_key = None
         self._save_profiles_json_path: Optional[str] = None
         self._overwrite_output_files = False
+        self._engine: str = "auto"
+        self._mesh = None
+
+    def with_engine(self, engine: str, mesh=None) -> "ColumnProfilerRunBuilder":
+        """"auto" (mesh when >1 device), "single", or "distributed"."""
+        self._engine = engine
+        self._mesh = mesh
+        return self
 
     def print_status_updates(self, value: bool) -> "ColumnProfilerRunBuilder":
         self._print_status_updates = value
@@ -83,6 +91,8 @@ class ColumnProfilerRunBuilder:
             reuse_existing_results_for_key=self._reuse_key,
             fail_if_results_missing=self._fail_if_results_missing,
             save_in_metrics_repository_using_key=self._save_key,
+            engine=self._engine,
+            mesh=self._mesh,
         )
         if self._save_profiles_json_path is not None:
             if os.path.exists(self._save_profiles_json_path) and not self._overwrite_output_files:

@@ -27,6 +27,16 @@ class AnalysisRunBuilder:
         self._save_key: Optional["ResultKey"] = None
         self._aggregate_with: Optional["StateLoader"] = None
         self._save_states_with: Optional["StatePersister"] = None
+        self._engine: str = "auto"
+        self._mesh = None
+
+    def with_engine(self, engine: str, mesh=None) -> "AnalysisRunBuilder":
+        """"auto" (mesh when >1 device), "single", or "distributed" —
+        mirrors the reference where partition parallelism is the default
+        execution path (reference: AnalysisRunner.scala:279-326)."""
+        self._engine = engine
+        self._mesh = mesh
+        return self
 
     def add_analyzer(self, analyzer: Analyzer) -> "AnalysisRunBuilder":
         self._analyzers.append(analyzer)
@@ -71,4 +81,6 @@ class AnalysisRunBuilder:
             reuse_existing_results_for_key=self._reuse_key,
             fail_if_results_missing=self._fail_if_results_missing,
             save_or_append_results_with_key=self._save_key,
+            engine=self._engine,
+            mesh=self._mesh,
         )

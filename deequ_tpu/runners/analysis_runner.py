@@ -43,9 +43,15 @@ class AnalysisRunner:
         reuse_existing_results_for_key: Optional["ResultKey"] = None,
         fail_if_results_missing: bool = False,
         save_or_append_results_with_key: Optional["ResultKey"] = None,
+        engine: str = "auto",
+        mesh=None,
     ) -> AnalyzerContext:
         if not analyzers:
             return AnalyzerContext.empty()
+
+        from deequ_tpu.runners.engine import resolve_engine
+
+        mesh = resolve_engine(engine, mesh, num_rows=data.num_rows)
 
         # deduplicate, preserving order
         seen = set()
@@ -97,7 +103,7 @@ class AnalysisRunner:
 
         # 4. fused scan pass (reference: AnalysisRunner.scala:279-326)
         scanning_results = AnalysisRunner._run_scanning_analyzers(
-            data, scanning, aggregate_with, save_states_with
+            data, scanning, aggregate_with, save_states_with, mesh
         )
 
         # 5. one frequency pass per grouping-column-set
@@ -107,7 +113,7 @@ class AnalysisRunner:
             from deequ_tpu.runners.grouping_runner import run_grouping_analyzers
 
             grouping_results = run_grouping_analyzers(
-                data, grouping, aggregate_with, save_states_with
+                data, grouping, aggregate_with, save_states_with, mesh=mesh
             )
 
         context = (
@@ -128,6 +134,7 @@ class AnalysisRunner:
         analyzers: Sequence[Analyzer],
         aggregate_with: Optional["StateLoader"],
         save_states_with: Optional["StatePersister"],
+        mesh=None,
     ) -> AnalyzerContext:
         if not analyzers:
             return AnalyzerContext.empty()
@@ -137,7 +144,12 @@ class AnalysisRunner:
 
         metrics: Dict[Analyzer, Metric] = {}
         if shareable:
-            results = FusedScanPass(shareable).run(data)
+            if mesh is not None:
+                from deequ_tpu.parallel.distributed import DistributedScanPass
+
+                results = DistributedScanPass(shareable, mesh=mesh).run(data)
+            else:
+                results = FusedScanPass(shareable).run(data)
             for result in results:
                 analyzer = result.analyzer
                 if result.error is not None:

@@ -1,0 +1,45 @@
+"""Execution-engine selection: single-device fused pass vs mesh-sharded
+distributed pass.
+
+The reference's partition parallelism is its DEFAULT execution path —
+every aggregation runs map-side partial + merge
+(reference: runners/AnalysisRunner.scala:279-326); it is not an opt-in
+side door. Mirroring that, every runner here takes `engine`:
+
+    "auto"         -> mesh over all devices when >1 device is attached,
+                      single-device otherwise (the default)
+    "single"       -> force the single-device fused pass
+    "distributed"  -> force the mesh pass (all devices, or `mesh`)
+
+Resolution returns the Mesh to shard over, or None for single-device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+VALID_ENGINES = ("auto", "single", "distributed")
+
+# "auto" shards only when the table can amortize the shard_map compile +
+# per-batch collective overhead; below this the single-device fused pass
+# wins outright. "distributed" ignores the threshold.
+AUTO_MIN_ROWS = 1 << 17
+
+
+def resolve_engine(engine: str = "auto", mesh=None, num_rows: Optional[int] = None):
+    if engine not in VALID_ENGINES:
+        raise ValueError(f"engine must be one of {VALID_ENGINES}, got {engine!r}")
+    if engine == "single":
+        return None
+    if engine == "auto" and num_rows is not None and num_rows < AUTO_MIN_ROWS:
+        return None
+    if mesh is not None:
+        return mesh
+    import jax
+
+    devices = jax.devices()
+    if engine == "distributed" or len(devices) > 1:
+        from deequ_tpu.parallel.distributed import data_mesh
+
+        return data_mesh(devices)
+    return None

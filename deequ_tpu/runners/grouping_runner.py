@@ -27,6 +27,7 @@ def run_grouping_analyzers(
     analyzers: Sequence["GroupingAnalyzer"],
     aggregate_with: Optional["StateLoader"] = None,
     save_states_with: Optional["StatePersister"] = None,
+    mesh=None,
 ) -> AnalyzerContext:
     from deequ_tpu.analyzers.frequency import (
         FrequencyBasedAnalyzer,
@@ -49,7 +50,7 @@ def run_grouping_analyzers(
 
     for cols, group in groups.items():
         try:
-            shared_state = compute_frequencies(data, list(cols))
+            shared_state = compute_frequencies(data, list(cols), mesh=mesh)
         except Exception as e:  # noqa: BLE001
             for analyzer in group:
                 metrics[analyzer] = analyzer.to_failure_metric(e)

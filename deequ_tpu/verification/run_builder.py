@@ -42,6 +42,14 @@ class VerificationRunBuilder:
         self._save_key: Optional["ResultKey"] = None
         self._aggregate_with: Optional["StateLoader"] = None
         self._save_states_with: Optional["StatePersister"] = None
+        self._engine: str = "auto"
+        self._mesh = None
+
+    def with_engine(self, engine: str, mesh=None) -> "VerificationRunBuilder":
+        """"auto" (mesh when >1 device), "single", or "distributed"."""
+        self._engine = engine
+        self._mesh = mesh
+        return self
 
     def add_check(self, check: Check) -> "VerificationRunBuilder":
         self._checks.append(check)
@@ -121,4 +129,6 @@ class VerificationRunBuilder:
             reuse_existing_results_for_key=self._reuse_key,
             fail_if_results_missing=self._fail_if_results_missing,
             save_or_append_results_with_key=self._save_key,
+            engine=self._engine,
+            mesh=self._mesh,
         )

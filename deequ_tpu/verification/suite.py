@@ -47,6 +47,8 @@ class VerificationSuite:
         reuse_existing_results_for_key: Optional["ResultKey"] = None,
         fail_if_results_missing: bool = False,
         save_or_append_results_with_key: Optional["ResultKey"] = None,
+        engine: str = "auto",
+        mesh=None,
     ) -> VerificationResult:
         """reference: VerificationSuite.scala:107-144."""
         analyzers: List[Analyzer] = list(required_analyzers)
@@ -62,6 +64,8 @@ class VerificationSuite:
             reuse_existing_results_for_key=reuse_existing_results_for_key,
             fail_if_results_missing=fail_if_results_missing,
             save_or_append_results_with_key=save_or_append_results_with_key,
+            engine=engine,
+            mesh=mesh,
         )
 
         return VerificationSuite.evaluate(checks, analysis_results)

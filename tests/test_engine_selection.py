@@ -1,0 +1,200 @@
+"""Distribution as THE engine: engine selection in every runner, and
+end-to-end mesh-vs-single parity for ALL analyzer families through
+VerificationSuite (the analogue of the reference default path,
+AnalysisRunner.scala:279-326, where partition parallelism is not opt-in).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    ApproxQuantile,
+    ApproxQuantiles,
+    Completeness,
+    Compliance,
+    Correlation,
+    CountDistinct,
+    DataType,
+    Distinctness,
+    Entropy,
+    Histogram,
+    Maximum,
+    Mean,
+    Minimum,
+    MutualInformation,
+    PatternMatch,
+    Size,
+    StandardDeviation,
+    Sum,
+    Uniqueness,
+    UniqueValueRatio,
+)
+from deequ_tpu.data.table import Table
+from deequ_tpu.profiles.column_profiler import ColumnProfiler
+from deequ_tpu.runners.analysis_runner import AnalysisRunner
+from deequ_tpu.runners.engine import AUTO_MIN_ROWS, resolve_engine
+from deequ_tpu.verification import VerificationSuite
+
+requires_virtual_mesh = pytest.mark.skipif(
+    len(jax.devices()) != 8,
+    reason="needs the 8-device virtual CPU mesh; running on real hardware",
+)
+
+
+def make_table(n=20_011, seed=3):  # prime-ish: exercises shard padding
+    rng = np.random.default_rng(seed)
+    x = rng.normal(10.0, 3.0, n)
+    x[rng.random(n) < 0.04] = np.nan
+    y = 0.3 * x + rng.normal(0, 1, n)
+    cats = np.array(["alpha", "beta", "gamma", "delta", None], dtype=object)
+    return Table.from_numpy(
+        {
+            "x": x,
+            "y": y,
+            "qty": rng.integers(0, 30, n),
+            "cat": cats[rng.integers(0, 5, n)],
+            "code": np.array(
+                [str(v) for v in rng.integers(0, 800, n)], dtype=object
+            ),
+        }
+    )
+
+
+# every analyzer family in SURVEY §2.5 (21 analyzers)
+ALL_ANALYZERS = [
+    Size(),
+    Completeness("x"),
+    Compliance("x big", "x >= 10"),
+    PatternMatch("cat", r"^(alp|bet)"),
+    Mean("x"),
+    Minimum("x"),
+    Maximum("x"),
+    Sum("x"),
+    StandardDeviation("x"),
+    Correlation("x", "y"),
+    DataType("code"),
+    ApproxCountDistinct("code"),
+    ApproxQuantile("x", 0.5),
+    ApproxQuantiles("x", (0.25, 0.5, 0.75)),
+    Uniqueness(["cat"]),
+    Distinctness(["cat"]),
+    UniqueValueRatio(["cat"]),
+    CountDistinct(["cat", "qty"]),
+    Entropy("cat"),
+    MutualInformation("cat", "qty"),
+    Histogram("cat"),
+]
+
+
+def _compare(map_d, map_s):
+    for analyzer in ALL_ANALYZERS:
+        md, ms = map_d[analyzer], map_s[analyzer]
+        assert md.value.is_success, (analyzer, md.value)
+        assert ms.value.is_success, (analyzer, ms.value)
+        vd, vs = md.value.get(), ms.value.get()
+        if isinstance(vd, float):
+            if repr(analyzer).startswith("ApproxQuantile("):
+                assert vd == pytest.approx(vs, abs=0.2), analyzer
+            else:
+                assert vd == pytest.approx(vs, rel=1e-9), analyzer
+        elif isinstance(vd, dict):  # KeyedDoubleMetric
+            for k in vd:
+                assert vd[k] == pytest.approx(vs[k], abs=0.2), (analyzer, k)
+        else:
+            assert vd == vs, analyzer
+
+
+class TestEngineParity:
+    @requires_virtual_mesh
+    def test_all_21_analyzers_mesh_equals_single(self):
+        table = make_table()
+        ctx_d = (
+            AnalysisRunner.on_data(table)
+            .add_analyzers(ALL_ANALYZERS)
+            .with_engine("distributed")
+            .run()
+        )
+        ctx_s = (
+            AnalysisRunner.on_data(table)
+            .add_analyzers(ALL_ANALYZERS)
+            .with_engine("single")
+            .run()
+        )
+        _compare(ctx_d.metric_map, ctx_s.metric_map)
+
+    @requires_virtual_mesh
+    def test_verification_suite_distributed(self):
+        table = make_table()
+        result = (
+            VerificationSuite.on_data(table)
+            .add_required_analyzers(ALL_ANALYZERS)
+            .with_engine("distributed")
+            .run()
+        )
+        single = (
+            VerificationSuite.on_data(table)
+            .add_required_analyzers(ALL_ANALYZERS)
+            .with_engine("single")
+            .run()
+        )
+        _compare(result.metrics, single.metrics)
+
+    @requires_virtual_mesh
+    def test_profiler_distributed(self):
+        table = make_table()
+        pd_ = ColumnProfiler.profile(table, engine="distributed")
+        ps = ColumnProfiler.profile(table, engine="single")
+        assert pd_.num_records == ps.num_records
+        for name in ("x", "qty", "cat", "code"):
+            d, s = pd_.profiles[name], ps.profiles[name]
+            assert d.data_type == s.data_type
+            assert d.completeness == pytest.approx(s.completeness, rel=1e-9)
+            assert d.approximate_num_distinct_values == (
+                s.approximate_num_distinct_values
+            )
+            if getattr(d, "mean", None) is not None:
+                assert d.mean == pytest.approx(s.mean, rel=1e-9)
+
+    def test_auto_threshold(self):
+        # tiny tables stay single-device under "auto"
+        assert resolve_engine("auto", num_rows=100) is None
+        if len(jax.devices()) > 1:
+            assert resolve_engine("auto", num_rows=AUTO_MIN_ROWS) is not None
+            assert resolve_engine("distributed", num_rows=1) is not None
+        assert resolve_engine("single", num_rows=10**9) is None
+        with pytest.raises(ValueError):
+            resolve_engine("warp")
+
+    @requires_virtual_mesh
+    def test_streaming_source_distributed(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        rng = np.random.default_rng(1)
+        n = 12_000
+        path = str(tmp_path / "d.parquet")
+        pq.write_table(
+            pa.table({"v": rng.normal(0, 1, n), "g": rng.integers(0, 7, n)}),
+            path,
+            row_group_size=2048,
+        )
+        source = Table.scan_parquet(path, batch_rows=2048)
+        analyzers = [Size(), Mean("v"), Uniqueness(["g"]), Entropy("g")]
+        ctx_d = (
+            AnalysisRunner.on_data(source)
+            .add_analyzers(analyzers)
+            .with_engine("distributed")
+            .run()
+        )
+        ctx_s = (
+            AnalysisRunner.on_data(Table.from_parquet(path))
+            .add_analyzers(analyzers)
+            .with_engine("single")
+            .run()
+        )
+        for a in analyzers:
+            assert ctx_d.metric_map[a].value.get() == pytest.approx(
+                ctx_s.metric_map[a].value.get(), rel=1e-9
+            ), a
