@@ -20,8 +20,9 @@ Knobs (env):
     BENCH_MODE      "profiler" | "scan" | "stream"  (default "profiler")
                     stream = full profile over an on-disk Parquet file via
                     Table.scan_parquet (out-of-core; constant host memory)
-    BENCH_TIMED     timed repetitions          (default 1; steady-state
-                     timing — compile happens during the warmup run)
+    BENCH_TIMED     timed repetitions, best-of (default 2: the tunneled
+                     chip shows large run-to-run variance; compile happens
+                     during the warmup run)
     BENCH_PARQUET   path for the stream-mode file (default /tmp/bench.parquet;
                      reused if it already has BENCH_ROWS rows)
 """
@@ -142,7 +143,7 @@ def write_parquet(n_rows: int, path: str, chunk: int = 2_000_000) -> None:
 def main() -> None:
     n_rows = int(os.environ.get("BENCH_ROWS", "10000000"))
     mode = os.environ.get("BENCH_MODE", "profiler")
-    reps = max(1, int(os.environ.get("BENCH_TIMED", "1")))
+    reps = max(1, int(os.environ.get("BENCH_TIMED", "2")))
 
     t_gen = time.perf_counter()
     if mode == "stream":

@@ -284,18 +284,26 @@ class ScanShareableAnalyzer(Analyzer):
     that can be fused with others into one compiled pass
     (reference: analyzers/Analyzer.scala:159-216).
 
-    Two flavors share the single scan: device-reduced analyzers contribute
-    traced reductions to the fused XLA program; host-reduced analyzers
-    (``host_reduced = True``, e.g. quantile digests) fold a partial State
-    per batch on the host while the device program runs."""
+    Two flavors share the single scan: device-REDUCED analyzers contribute
+    traced reductions whose outputs merge in-graph / cross-batch via
+    `merge_agg`; device-ASSISTED analyzers (``device_assisted = True``,
+    e.g. quantile sketches) contribute a traced per-batch computation
+    (`device_batch` — the heavy part, e.g. the sort) whose fixed-size
+    output is consumed on the host each batch (`host_consume`) instead of
+    being merged in-graph — the host keeps only the sketch fold."""
 
-    host_reduced = False
+    device_assisted = False
 
-    def host_prepare(self):
-        """Per-pass setup for a host-reduced analyzer: validate parameters
-        and return a `reduce(batch) -> Optional[State]` closure. Errors here
-        fail this analyzer alone (mirrors device spec isolation). Only
-        called when host_reduced is True."""
+    def device_batch(self, inputs: Dict[str, Any], xp) -> Any:
+        """Per-batch traced computation for a device-assisted analyzer.
+        Output leaves must be 1-D arrays (scalars as shape-(1,)) so the
+        mesh pass can gather per-device outputs along axis 0. Only called
+        when device_assisted is True."""
+        raise NotImplementedError
+
+    def host_consume(self, state: Optional[State], batch_output: Any) -> Optional[State]:
+        """Fold one batch's (or one device shard's) device_batch output
+        into the running State. Only called when device_assisted."""
         raise NotImplementedError
 
     def input_specs(self) -> List[InputSpec]:

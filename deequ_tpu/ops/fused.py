@@ -40,13 +40,23 @@ def _pad_size(n: int, batch_size: int) -> int:
     return min(size, max(batch_size, 8))
 
 
-def get_fused_fn(analyzers: Sequence[ScanShareableAnalyzer]):
-    key = (tuple(repr(a) for a in analyzers), bool(jax.config.jax_enable_x64))
+def get_fused_fn(
+    analyzers: Sequence[ScanShareableAnalyzer],
+    assisted: Sequence[ScanShareableAnalyzer] = (),
+):
+    key = (
+        tuple(repr(a) for a in analyzers),
+        tuple(repr(a) for a in assisted),
+        bool(jax.config.jax_enable_x64),
+    )
     fn = _FUSED_CACHE.get(key)
     if fn is None:
 
         def fused(inputs):
-            return tuple(a.device_reduce(inputs, jnp) for a in analyzers)
+            return (
+                tuple(a.device_reduce(inputs, jnp) for a in analyzers),
+                tuple(a.device_batch(inputs, jnp) for a in assisted),
+            )
 
         fn = jax.jit(fused)
         _FUSED_CACHE[key] = fn
@@ -80,16 +90,29 @@ def _to_f64(tree: Any) -> Any:
 
 
 class PipelinedAggFold:
-    """Cross-batch semigroup fold that overlaps device compute with host
-    work: each submitted batch output starts an async D2H copy, and the
+    """Cross-batch host fold that overlaps device compute with host work:
+    each submitted batch output starts an async D2H copy, and the
     PREVIOUS batch (whose copy has had a full batch of device time to
-    land) is fetched and folded in float64 via the analyzers' merge_agg.
-    Avoids paying the device round-trip latency per batch — on a tunneled
-    device that latency (~20ms) would otherwise dominate small folds."""
+    land) is fetched and folded. Avoids paying the device round-trip
+    latency per batch — on a tunneled device that latency (~20ms) would
+    otherwise dominate small folds.
 
-    def __init__(self, analyzers: Sequence[ScanShareableAnalyzer]):
+    Two kinds of outputs per batch: merge-analyzers' aggregates fold in
+    float64 via merge_agg; assisted-analyzers' per-batch artifacts are
+    handed to host_consume, once per device shard (`n_dev` shards are
+    gathered along leaf axis 0 by the mesh pass)."""
+
+    def __init__(
+        self,
+        analyzers: Sequence[ScanShareableAnalyzer],
+        assisted: Sequence[ScanShareableAnalyzer] = (),
+        n_dev: int = 1,
+    ):
         self.analyzers = list(analyzers)
+        self.assisted = list(assisted)
+        self.n_dev = n_dev
         self._total: Optional[List[Any]] = None
+        self._assisted_states: List[Any] = [None] * len(self.assisted)
         self._pending = None
 
     def submit(self, device_out) -> None:
@@ -99,20 +122,31 @@ class PipelinedAggFold:
         self._pending = device_out
 
     def _fold(self, device_out) -> None:
-        batch_aggs = [_to_f64(t) for t in jax.device_get(device_out)]
+        merge_out, assisted_out = jax.device_get(device_out)
+        batch_aggs = [_to_f64(t) for t in merge_out]
         if self._total is None:
             self._total = batch_aggs
-        else:
+        elif batch_aggs:
             self._total = [
                 a.merge_agg(t, b, np)
                 for a, t, b in zip(self.analyzers, self._total, batch_aggs)
             ]
+        for i, (analyzer, out) in enumerate(zip(self.assisted, assisted_out)):
+            for d in range(self.n_dev):
+                shard = jax.tree_util.tree_map(
+                    lambda x, d=d: np.asarray(x).reshape(self.n_dev, -1)[d], out
+                )
+                self._assisted_states[i] = analyzer.host_consume(
+                    self._assisted_states[i], shard
+                )
 
-    def finish(self) -> List[Any]:
+    def finish(self):
         if self._pending is not None:
             self._fold(self._pending)
             self._pending = None
-        return self._total if self._total is not None else []
+        return (
+            self._total if self._total is not None else []
+        ), self._assisted_states
 
 
 class FusedScanPass:
@@ -129,82 +163,65 @@ class FusedScanPass:
     def run(self, table: Table) -> List[AnalyzerRunResult]:
         # 1. collect input specs; an analyzer whose spec construction fails
         #    (e.g. unparseable predicate) fails alone, not the pass
-        device_idx: List[int] = []
-        host_idx: List[int] = []
-        host_reducers: List[Any] = []
+        merge_idx: List[int] = []
+        assisted_idx: List[int] = []
         results: Dict[int, AnalyzerRunResult] = {}
         specs: Dict[str, Any] = {}
         for i, analyzer in enumerate(self.analyzers):
-            if getattr(analyzer, "host_reduced", False):
-                try:
-                    reducer = analyzer.host_prepare()
-                except Exception as e:  # noqa: BLE001
-                    results[i] = AnalyzerRunResult(analyzer, error=e)
-                    continue
-                host_idx.append(i)
-                host_reducers.append(reducer)
-                continue
             try:
                 analyzer_specs = analyzer.input_specs()
             except Exception as e:  # noqa: BLE001
                 results[i] = AnalyzerRunResult(analyzer, error=e)
                 continue
-            device_idx.append(i)
+            if getattr(analyzer, "device_assisted", False):
+                assisted_idx.append(i)
+            else:
+                merge_idx.append(i)
             for spec in analyzer_specs:
                 specs.setdefault(spec.key, spec)
 
-        if device_idx or host_idx:
-            device_analyzers = [self.analyzers[i] for i in device_idx]
-            host_analyzers = [self.analyzers[i] for i in host_idx]
+        if merge_idx or assisted_idx:
+            merge_analyzers = [self.analyzers[i] for i in merge_idx]
+            assisted = [self.analyzers[i] for i in assisted_idx]
             try:
-                aggs, host_states = self._run_pass(
-                    table, device_analyzers, specs, host_analyzers, host_reducers
+                aggs, assisted_states = self._run_pass(
+                    table, merge_analyzers, specs, assisted
                 )
-                for i, analyzer, agg in zip(device_idx, device_analyzers, aggs):
+                for i, analyzer, agg in zip(merge_idx, merge_analyzers, aggs):
                     results[i] = AnalyzerRunResult(
                         analyzer, state=analyzer.state_from_aggregates(agg)
                     )
-                for i, analyzer, state in zip(host_idx, host_analyzers, host_states):
+                for i, analyzer, state in zip(assisted_idx, assisted, assisted_states):
                     results[i] = AnalyzerRunResult(analyzer, state=state)
             except Exception as e:  # noqa: BLE001
                 # a runtime failure of the shared pass fails every analyzer in
                 # it (reference: AnalysisRunner.scala:310-313)
-                for i in device_idx + host_idx:
+                for i in merge_idx + assisted_idx:
                     results[i] = AnalyzerRunResult(self.analyzers[i], error=e)
 
         return [results[i] for i in range(len(self.analyzers))]
 
-    def _run_pass(self, table: Table, analyzers, specs, host_analyzers=(), host_reducers=()):
-        fused = get_fused_fn(analyzers) if analyzers else None
+    def _run_pass(self, table: Table, analyzers, specs, assisted=()):
+        fused = get_fused_fn(analyzers, assisted)
         dtype = runtime.compute_dtype()
         runtime.record_pass(
-            "scan:" + ",".join(a.name for a in list(analyzers) + list(host_analyzers))
+            "scan:" + ",".join(a.name for a in list(analyzers) + list(assisted))
         )
 
-        host_states: List[Any] = [None] * len(host_analyzers)
-        fold = PipelinedAggFold(analyzers)
+        fold = PipelinedAggFold(analyzers, assisted)
 
         for batch in table.batches(self.batch_size):
-            if fused is not None:
-                padded = _pad_size(batch.num_rows, self.batch_size)
-                inputs: Dict[str, jnp.ndarray] = {}
-                for key, spec in specs.items():
-                    arr = spec.build(batch)
-                    arr = runtime.pad_to(np.asarray(arr), padded)
-                    if arr.dtype == np.bool_ or np.issubdtype(arr.dtype, np.integer):
-                        inputs[key] = jnp.asarray(arr)
-                    else:
-                        inputs[key] = jnp.asarray(arr.astype(dtype))
-                runtime.record_launch()
-                # async dispatch: the device crunches this batch while the
-                # host folds the previous batch and runs host reducers
-                fold.submit(fused(inputs))
-            for j, reducer in enumerate(host_reducers):
-                partial = reducer(batch)
-                if partial is not None:
-                    host_states[j] = (
-                        partial
-                        if host_states[j] is None
-                        else host_states[j].merge(partial)
-                    )
-        return fold.finish(), host_states
+            padded = _pad_size(batch.num_rows, self.batch_size)
+            inputs: Dict[str, jnp.ndarray] = {}
+            for key, spec in specs.items():
+                arr = spec.build(batch)
+                arr = runtime.pad_to(np.asarray(arr), padded)
+                if arr.dtype == np.bool_ or np.issubdtype(arr.dtype, np.integer):
+                    inputs[key] = jnp.asarray(arr)
+                else:
+                    inputs[key] = jnp.asarray(arr.astype(dtype))
+            runtime.record_launch()
+            # async dispatch: the device crunches this batch while the
+            # host folds the previous batch
+            fold.submit(fused(inputs))
+        return fold.finish()

@@ -9,13 +9,13 @@ scatter-max (`zeros.at[idx].max(rank)`), and merging is elementwise max —
 which on a mesh is literally `lax.pmax` over the register array.
 
 Same parameters as the reference: relativeSD=0.05 -> p=9, m=512 registers
-(reference: StatefulHyperloglogPlus.scala:154-155). Estimation uses the
-HLL++ raw estimate with linear-counting fallback and `round()`, so small
-cardinalities are exact integers like the reference's
-(reference: StatefulHyperloglogPlus.scala:210-256). We deliberately skip
-the empirical bias-interpolation tables (public Spark constants): mid-range
-estimates may differ from the reference by <~1%, still inside the declared
-rsd=0.05 (divergence documented in BASELINE.md terms).
+(reference: StatefulHyperloglogPlus.scala:154-155). Estimation is the
+full HLL++ pipeline — linear counting under the precision threshold,
+empirical bias interpolation (K=6 nearest points of the published p=9
+tables, hll_bias.py) below 5m, raw estimate above — with the same branch
+structure as the reference (StatefulHyperloglogPlus.scala:210-297), so
+small cardinalities are exact integers and mid-range estimates carry the
+same correction.
 """
 
 from __future__ import annotations
@@ -126,18 +126,43 @@ def merge_registers(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return np.maximum(a, b)
 
 
+def estimate_bias(e: float) -> float:
+    """Empirical bias for a raw estimate: mean bias of the K=6 nearest
+    interpolation points, by squared distance, exactly like the reference
+    (reference: StatefulHyperloglogPlus.scala:258-297)."""
+    from deequ_tpu.ops.sketches.hll_bias import BIAS_P9, K_NEAREST, RAW_ESTIMATE_P9
+
+    estimates = RAW_ESTIMATE_P9
+    n = len(estimates)
+    nearest = int(np.searchsorted(estimates, e, side="left"))
+
+    low = max(nearest - K_NEAREST + 1, 0)
+    high = min(low + K_NEAREST, n)
+    while high < n and (e - estimates[high]) ** 2 < (e - estimates[low]) ** 2:
+        low += 1
+        high += 1
+    return float(np.mean(BIAS_P9[low:high]))
+
+
 def estimate(registers: np.ndarray) -> float:
-    """HLL++ raw estimate + linear-counting fallback, rounded
-    (reference: StatefulHyperloglogPlus.scala:210-256)."""
+    """Full HLL++ estimator: raw estimate with empirical bias correction
+    below 5m, linear counting below the precision threshold, rounded
+    (reference: StatefulHyperloglogPlus.scala:210-256 — same branch
+    structure and constants)."""
+    from deequ_tpu.ops.sketches.hll_bias import THRESHOLD_P9
+
     z_inverse = np.sum(np.float64(1.0) / (np.uint64(1) << registers.astype(np.uint64)))
     v = float(np.sum(registers == 0))
+
     e = ALPHA_M2 / z_inverse
+    e_bias_corrected = e - estimate_bias(e) if e < 5.0 * M else e
+
     if v > 0:
-        linear = M * np.log(M / v)
-        # prefer linear counting in its accurate regime
-        if linear <= 2.5 * M:
-            return float(round(linear))
-    return float(round(e))
+        # linear counting for small cardinalities
+        h = M * np.log(M / v)
+        if h <= THRESHOLD_P9:
+            return float(round(h))
+    return float(round(e_bias_corrected))
 
 
 def pack_words(registers: np.ndarray) -> np.ndarray:

@@ -64,17 +64,36 @@ class KLLSketch:
         ones; the introduced rank error stays O(2^L), the same order as
         the cascade's). Turns per-batch cost from ~2 sorts of m into one."""
         m = len(values)
-        self.n += m
         target_level = max(0, int(np.ceil(np.log2(m / (2.0 * self.k)))))
         stride = 1 << target_level
         sorted_vals = np.sort(values)
         offset = int(self._rng.integers(0, stride))
         promoted = sorted_vals[offset::stride]
-        while len(self.levels) <= target_level:
+        return self.insert_level(promoted, target_level, true_count=m)
+
+    def insert_level(
+        self,
+        sorted_values: np.ndarray,
+        level: int,
+        true_count: Optional[int] = None,
+    ) -> "KLLSketch":
+        """Insert an already-decimated SORTED sample whose items carry
+        weight 2^level (the device-sort path hands these over: the device
+        sorts and stride-decimates, the host only merges). `true_count`
+        is the exact number of underlying rows the sample summarizes."""
+        self.n += int(true_count) if true_count is not None else (
+            len(sorted_values) << level
+        )
+        if len(sorted_values) == 0:
+            return self
+        while len(self.levels) <= level:
             self.levels.append(np.empty(0, dtype=np.float64))
         # both sides sorted: timsort exploits the runs (linear merge)
-        self.levels[target_level] = np.sort(
-            np.concatenate([self.levels[target_level], promoted]), kind="stable"
+        self.levels[level] = np.sort(
+            np.concatenate(
+                [self.levels[level], np.asarray(sorted_values, dtype=np.float64)]
+            ),
+            kind="stable",
         )
         self._compress()
         return self

@@ -39,12 +39,13 @@ def data_mesh(devices: Optional[Sequence] = None, axis_name: str = DATA_AXIS) ->
     return Mesh(np.array(devices), (axis_name,))
 
 
-def _get_distributed_fn(analyzers, mesh: Mesh, axis_name: str):
+def _get_distributed_fn(analyzers, mesh: Mesh, axis_name: str, assisted=()):
     # Mesh hashes/compares by content (devices + axis names), giving a
     # stable cache identity — unlike id(mesh), which can be recycled
     # after GC and return a function compiled for a dead mesh.
     key = (
         tuple(repr(a) for a in analyzers),
+        tuple(repr(a) for a in assisted),
         mesh,
         axis_name,
         bool(jax.config.jax_enable_x64),
@@ -72,13 +73,19 @@ def _get_distributed_fn(analyzers, mesh: Mesh, axis_name: str):
                 shard = jax.tree_util.tree_map(lambda x, d=d: x[d], tree)
                 acc = analyzer.merge_agg(acc, shard, jnp)
             merged.append(acc)
-        return tuple(merged)
+
+        # device-assisted outputs (e.g. the quantile sort+decimate) stay
+        # per-device: each shard's fixed-size artifact is gathered along
+        # axis 0 and consumed host-side shard by shard
+        assisted_out = tuple(a.device_batch(inputs, jnp) for a in assisted)
+        return tuple(merged), assisted_out
 
     sharded = jax.shard_map(
         per_device,
         mesh=mesh,
         in_specs=(P(axis_name),),
-        out_specs=P(),  # merged states are replicated
+        # merged states replicated; assisted artifacts concatenated per device
+        out_specs=(P(), P(axis_name)),
         check_vma=False,
     )
     fn = jax.jit(sharded)
@@ -101,49 +108,38 @@ class DistributedScanPass:
         self.mesh = mesh if mesh is not None else data_mesh()
         self.axis_name = axis_name
         self.batch_size_per_device = batch_size_per_device
-        self._executor = None
-
-    def _pool(self):
-        if self._executor is None:
-            import os
-            from concurrent.futures import ThreadPoolExecutor
-
-            workers = min(
-                self.mesh.shape[self.axis_name], os.cpu_count() or 1
-            )
-            self._executor = ThreadPoolExecutor(max_workers=workers)
-        return self._executor
 
     def run(self, table: Table) -> List[AnalyzerRunResult]:
-        device_analyzers: List[ScanShareableAnalyzer] = []
-        device_idx: List[int] = []
-        host_idx: List[int] = []
-        host_reducers: List[Any] = []
+        merge_analyzers: List[ScanShareableAnalyzer] = []
+        merge_idx: List[int] = []
+        assisted: List[ScanShareableAnalyzer] = []
+        assisted_idx: List[int] = []
         results: Dict[int, AnalyzerRunResult] = {}
         specs: Dict[str, Any] = {}
 
         for i, analyzer in enumerate(self.analyzers):
-            if getattr(analyzer, "host_reduced", False):
-                try:
-                    host_reducers.append(analyzer.host_prepare())
-                    host_idx.append(i)
-                except Exception as e:  # noqa: BLE001
-                    results[i] = AnalyzerRunResult(analyzer, error=e)
-                continue
             try:
-                for spec in analyzer.input_specs():
-                    specs.setdefault(spec.key, spec)
-                device_analyzers.append(analyzer)
-                device_idx.append(i)
+                analyzer_specs = analyzer.input_specs()
             except Exception as e:  # noqa: BLE001
                 results[i] = AnalyzerRunResult(analyzer, error=e)
+                continue
+            for spec in analyzer_specs:
+                specs.setdefault(spec.key, spec)
+            if getattr(analyzer, "device_assisted", False):
+                assisted.append(analyzer)
+                assisted_idx.append(i)
+            else:
+                merge_analyzers.append(analyzer)
+                merge_idx.append(i)
 
         n_devices = self.mesh.shape[self.axis_name]
         global_batch = self.batch_size_per_device * n_devices
         dtype = runtime.compute_dtype()
         fn = (
-            _get_distributed_fn(device_analyzers, self.mesh, self.axis_name)
-            if device_analyzers
+            _get_distributed_fn(
+                merge_analyzers, self.mesh, self.axis_name, assisted
+            )
+            if merge_analyzers or assisted
             else None
         )
         runtime.record_pass(
@@ -155,67 +151,36 @@ class DistributedScanPass:
         )
 
         try:
-            host_states: List[Any] = [None] * len(host_idx)
-            fold = PipelinedAggFold(device_analyzers)
+            fold = PipelinedAggFold(merge_analyzers, assisted, n_dev=n_devices)
 
             for batch in table.batches(global_batch):
-                if fn is not None:
-                    # pad to a multiple of n_devices (pow2 per device shard)
-                    per_dev = _pad_size(
-                        -(-batch.num_rows // n_devices), self.batch_size_per_device
-                    )
-                    padded = per_dev * n_devices
-                    inputs: Dict[str, Any] = {}
-                    for key, spec in specs.items():
-                        arr = runtime.pad_to(np.asarray(spec.build(batch)), padded)
-                        if not (
-                            arr.dtype == np.bool_
-                            or np.issubdtype(arr.dtype, np.integer)
-                        ):
-                            arr = arr.astype(dtype)
-                        inputs[key] = jax.device_put(arr, in_sharding[key])
-                    runtime.record_launch()
-                    fold.submit(fn(inputs))
-                if host_reducers:
-                    # host-reduced analyzers (quantile sketches) run on
-                    # per-device row shards in a thread pool — numpy sorts
-                    # release the GIL, so shards reduce in parallel, and
-                    # the per-shard partial states merge like any other
-                    # semigroup state
-                    shard_bounds = [
-                        (s, min(s + self.batch_size_per_device, batch.num_rows))
-                        for s in range(
-                            0, batch.num_rows, self.batch_size_per_device
-                        )
-                    ]
-                    shards = (
-                        [batch.slice(a, b) for a, b in shard_bounds]
-                        if len(shard_bounds) > 1
-                        else [batch]
-                    )
-                    for j, reducer in enumerate(host_reducers):
-                        partials = (
-                            list(self._pool().map(reducer, shards))
-                            if len(shards) > 1
-                            else [reducer(shards[0])]
-                        )
-                        for partial in partials:
-                            if partial is not None:
-                                host_states[j] = (
-                                    partial
-                                    if host_states[j] is None
-                                    else host_states[j].merge(partial)
-                                )
-            for i, analyzer, agg in zip(
-                device_idx, device_analyzers, fold.finish()
-            ):
+                if fn is None:
+                    continue
+                # pad to a multiple of n_devices (pow2 per device shard)
+                per_dev = _pad_size(
+                    -(-batch.num_rows // n_devices), self.batch_size_per_device
+                )
+                padded = per_dev * n_devices
+                inputs: Dict[str, Any] = {}
+                for key, spec in specs.items():
+                    arr = runtime.pad_to(np.asarray(spec.build(batch)), padded)
+                    if not (
+                        arr.dtype == np.bool_
+                        or np.issubdtype(arr.dtype, np.integer)
+                    ):
+                        arr = arr.astype(dtype)
+                    inputs[key] = jax.device_put(arr, in_sharding[key])
+                runtime.record_launch()
+                fold.submit(fn(inputs))
+            aggs, assisted_states = fold.finish()
+            for i, analyzer, agg in zip(merge_idx, merge_analyzers, aggs):
                 results[i] = AnalyzerRunResult(
                     analyzer, state=analyzer.state_from_aggregates(agg)
                 )
-            for i, state in zip(host_idx, host_states):
+            for i, state in zip(assisted_idx, assisted_states):
                 results[i] = AnalyzerRunResult(self.analyzers[i], state=state)
         except Exception as e:  # noqa: BLE001
-            for i in device_idx + host_idx:
+            for i in merge_idx + assisted_idx:
                 results[i] = AnalyzerRunResult(self.analyzers[i], error=e)
 
         return [results[i] for i in range(len(self.analyzers))]

@@ -41,6 +41,42 @@ class TestBulkInsert:
             rank = np.searchsorted(exact, merged.quantile(q), side="right") / len(exact)
             assert abs(rank - q) <= 0.01, (q, rank)
 
+    def test_device_assisted_rank_error_within_contract(self):
+        """The fused-pass quantile path (device sort + stride decimation,
+        host KLL level-inserts) must satisfy the same rank-error contract
+        across many batches."""
+        import pytest
+
+        from deequ_tpu.analyzers import ApproxQuantiles
+        from deequ_tpu.data.table import Table
+        from deequ_tpu.ops.fused import FusedScanPass
+
+        rng = np.random.default_rng(17)
+        values = rng.lognormal(0.0, 1.5, 600_000)
+        t = Table.from_numpy({"v": values})
+        analyzer = ApproxQuantiles("v", (0.01, 0.1, 0.5, 0.9, 0.99))
+        result = FusedScanPass([analyzer], batch_size=1 << 16).run(t)[0]  # 10 batches
+        metric = analyzer.compute_metric_from(result.state_or_raise())
+        exact_sorted = np.sort(values)
+        for q, estimate in metric.value.get().items():
+            rank = np.searchsorted(exact_sorted, estimate, side="right") / len(values)
+            assert abs(rank - float(q)) <= 0.01, (q, rank)
+
+    def test_device_assisted_with_where_filter(self):
+        from deequ_tpu.analyzers import ApproxQuantile
+        from deequ_tpu.data.table import Table
+        from deequ_tpu.ops.fused import FusedScanPass
+
+        t = Table.from_numpy(
+            {"v": np.arange(10_000, dtype=np.float64),
+             "g": np.arange(10_000) % 2}
+        )
+        analyzer = ApproxQuantile("v", 0.5, where="g = 0")
+        result = FusedScanPass([analyzer]).run(t)[0]
+        metric = analyzer.compute_metric_from(result.state_or_raise())
+        # evens only: median ~ 5000 +- sketch error
+        assert abs(metric.value.get() - 5000) <= 150
+
     def test_small_batches_unaffected(self):
         # below the bulk threshold the buffered path still runs
         sketch = KLLSketch(k=64, seed=3)
