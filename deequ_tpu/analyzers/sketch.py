@@ -219,6 +219,12 @@ class _QuantileAnalyzerBase(ScanShareableAnalyzer):
     tested). This lowers the sketch's compactor work to an XLA sort, the
     north-star requirement, and makes quantiles scale with mesh devices
     via shard_map like every device-reduced analyzer.
+
+    Precision note: on a float32 device engine (TPU with x64 off) the
+    column is sorted in float32, so quantile RESULTS are quantized to one
+    float32 ulp of the value's magnitude (e.g. ~2.7e8 for
+    microsecond-epoch timestamps ~1.7e15). The rank error bound is
+    unaffected. The CPU/x64 engine sketches exact float64.
     (reference: catalyst/StatefulApproxQuantile.scala:28 — the mergeable
     digest role; the sort+decimate replaces its per-row GK updates.)"""
 

@@ -94,8 +94,10 @@ def _get_distributed_fn(analyzers, mesh: Mesh, axis_name: str, assisted=()):
 
 
 class DistributedScanPass:
-    """Mesh-sharded variant of FusedScanPass (device-reduced analyzers;
-    host-reduced ones keep their host fold)."""
+    """Mesh-sharded variant of FusedScanPass: device-reduced analyzers
+    merge in-graph via collectives; device-assisted analyzers (quantile
+    sketches) produce fixed-size per-shard artifacts gathered along the
+    mesh axis and folded on the host shard by shard."""
 
     def __init__(
         self,
