@@ -70,6 +70,7 @@ def _f(xp, x):
 class Size(ScanShareableAnalyzer):
     """# rows, optionally filtered (reference: analyzers/Size.scala:36)."""
 
+    discrete_inputs = True  # mask-only: host-foldable under placement
     where: Optional[str] = None
 
     @property
@@ -124,6 +125,8 @@ class _RatioAnalyzer(ScanShareableAnalyzer):
     the criterion NULL, so the guard is "any row with where ∧ non-null
     input" (reference: analyzers/Completeness.scala:36-41,
     Compliance.scala:50, PatternMatch.scala:42-50)."""
+
+    discrete_inputs = True  # mask-only: host-foldable under placement
 
     def _match_mask_key(self) -> str:
         raise NotImplementedError
@@ -687,6 +690,7 @@ class DataType(ScanShareableAnalyzer):
     become NULL before classification (exactly like conditionalSelection
     feeding the reference UDAF), so they count as Unknown."""
 
+    discrete_inputs = True  # code-only: host-foldable under placement
     column: str
     where: Optional[str] = None
 

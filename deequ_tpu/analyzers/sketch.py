@@ -95,6 +95,7 @@ def _hll_spec(column: str) -> InputSpec:
 class ApproxCountDistinct(ScanShareableAnalyzer):
     """HLL++ distinct estimate (reference: analyzers/ApproxCountDistinct.scala:47)."""
 
+    discrete_inputs = True  # packed idx|rank codes: host-foldable
     column: str
     where: Optional[str] = None
 

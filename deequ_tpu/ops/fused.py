@@ -147,7 +147,7 @@ def get_fused_fn(
     return cached
 
 
-def pack_batch_inputs(batch, spec_items, padded: int, dtype, sticky=None):
+def pack_batch_inputs(built_items, padded: int, dtype, sticky=None, num_rows=None):
     """Build the minimal wire format for one batch.
 
     The tunnel to the device moves ~10MB/s (measured; a real TPU host moves
@@ -171,8 +171,9 @@ def pack_batch_inputs(batch, spec_items, padded: int, dtype, sticky=None):
         sticky = {}
     entries_by_group: Dict[tuple, List[tuple]] = {}
     const_keys: List[str] = []
-    for key, spec in spec_items:
-        arr = np.asarray(spec.build(batch))
+    for key, arr in built_items:
+        if num_rows is None:
+            num_rows = len(arr)
         if arr.dtype == np.bool_:
             if arr.all() and sticky.get(key, "const") == "const":
                 sticky[key] = "const"
@@ -222,7 +223,7 @@ def pack_batch_inputs(batch, spec_items, padded: int, dtype, sticky=None):
         groups.append((group_name, tuple((e[0], e[1]) for e in entries)))
     if const_keys:
         packed_inputs["__nrows"] = jnp.asarray(
-            np.array([batch.num_rows], dtype=np.int32)
+            np.array([num_rows or 0], dtype=np.int32)
         )
     layout = (tuple(groups), tuple(sorted(const_keys)), padded)
     return packed_inputs, layout
@@ -332,11 +333,18 @@ class FusedScanPass:
 
     def run(self, table: Table) -> List[AnalyzerRunResult]:
         # 1. collect input specs; an analyzer whose spec construction fails
-        #    (e.g. unparseable predicate) fails alone, not the pass
+        #    (e.g. unparseable predicate) fails alone, not the pass.
+        #    Placement (runtime.placement_mode): on a slow device link,
+        #    discrete analyzers (mask/code-only inputs) fold on the host
+        #    inside the SAME logical scan instead of shipping rows.
+        host_discrete = runtime.placement_mode() == "host-discrete"
         merge_idx: List[int] = []
         assisted_idx: List[int] = []
+        host_idx: List[int] = []
         results: Dict[int, AnalyzerRunResult] = {}
         specs: Dict[str, Any] = {}
+        device_keys: set = set()
+        host_keys: Dict[int, List[str]] = {}
         for i, analyzer in enumerate(self.analyzers):
             try:
                 analyzer_specs = analyzer.input_specs()
@@ -345,33 +353,59 @@ class FusedScanPass:
                 continue
             if getattr(analyzer, "device_assisted", False):
                 assisted_idx.append(i)
+                device_keys.update(s.key for s in analyzer_specs)
+            elif host_discrete and getattr(analyzer, "discrete_inputs", False):
+                host_idx.append(i)
+                host_keys[i] = [s.key for s in analyzer_specs]
             else:
                 merge_idx.append(i)
+                device_keys.update(s.key for s in analyzer_specs)
             for spec in analyzer_specs:
                 specs.setdefault(spec.key, spec)
 
-        if merge_idx or assisted_idx:
+        if merge_idx or assisted_idx or host_idx:
             merge_analyzers = [self.analyzers[i] for i in merge_idx]
             assisted = [self.analyzers[i] for i in assisted_idx]
+            host_members = [(i, self.analyzers[i]) for i in host_idx]
             try:
-                aggs, assisted_states = self._run_pass(
-                    table, merge_analyzers, specs, assisted
+                aggs, assisted_states, host_results, device_error = self._run_pass(
+                    table, merge_analyzers, specs, assisted,
+                    device_keys, host_members,
                 )
-                for i, analyzer, agg in zip(merge_idx, merge_analyzers, aggs):
-                    results[i] = AnalyzerRunResult(
-                        analyzer, state=analyzer.state_from_aggregates(agg)
-                    )
-                for i, analyzer, state in zip(assisted_idx, assisted, assisted_states):
-                    results[i] = AnalyzerRunResult(analyzer, state=state)
+                if device_error is not None:
+                    # a runtime failure of the shared device program fails
+                    # every analyzer IN that program; host-folded members
+                    # keep their own outcomes
+                    # (reference: AnalysisRunner.scala:310-313)
+                    for i in merge_idx + assisted_idx:
+                        results[i] = AnalyzerRunResult(
+                            self.analyzers[i], error=device_error
+                        )
+                else:
+                    for i, analyzer, agg in zip(merge_idx, merge_analyzers, aggs):
+                        results[i] = AnalyzerRunResult(
+                            analyzer, state=analyzer.state_from_aggregates(agg)
+                        )
+                    for i, analyzer, state in zip(
+                        assisted_idx, assisted, assisted_states
+                    ):
+                        results[i] = AnalyzerRunResult(analyzer, state=state)
+                results.update(host_results)
             except Exception as e:  # noqa: BLE001
-                # a runtime failure of the shared pass fails every analyzer in
-                # it (reference: AnalysisRunner.scala:310-313)
-                for i in merge_idx + assisted_idx:
-                    results[i] = AnalyzerRunResult(self.analyzers[i], error=e)
+                for i in merge_idx + assisted_idx + host_idx:
+                    results.setdefault(i, AnalyzerRunResult(self.analyzers[i], error=e))
 
         return [results[i] for i in range(len(self.analyzers))]
 
-    def _run_pass(self, table: Table, analyzers, specs, assisted=()):
+    def _run_pass(
+        self,
+        table: Table,
+        analyzers,
+        specs,
+        assisted=(),
+        device_keys=None,
+        host_members=(),
+    ):
         dtype = runtime.compute_dtype()
         if (
             np.dtype(dtype) == np.float32
@@ -383,22 +417,84 @@ class FusedScanPass:
                 "counts would lose exactness in the float32 packed "
                 "transfer. Use a smaller batch_size."
             )
+        if device_keys is None:
+            device_keys = set(specs)
         runtime.record_pass(
-            "scan:" + ",".join(a.name for a in list(analyzers) + list(assisted))
+            "scan:"
+            + ",".join(
+                a.name
+                for a in list(analyzers) + list(assisted) + [m for _, m in host_members]
+            )
         )
 
         fold = PipelinedAggFold(analyzers, assisted)
-        spec_items = sorted(specs.items())  # deterministic layout
+        device_spec_keys = sorted(device_keys)
+        use_device = bool(analyzers or assisted)
 
+        # host fold state: per host member, (f64 aggregate, error)
+        host_aggs: Dict[int, Any] = {}
+        host_errors: Dict[int, BaseException] = {}
+        device_error: Optional[BaseException] = None
+
+        host_member_keys = {
+            i: [s.key for s in member.input_specs()] for i, member in host_members
+        }
         sticky: Dict[str, Any] = {}
         for batch in table.batches(self.batch_size):
-            padded = _pad_size(batch.num_rows, self.batch_size)
-            packed_inputs, layout = pack_batch_inputs(
-                batch, spec_items, padded, dtype, sticky
-            )
-            fused, meta_box = get_fused_fn(analyzers, assisted, layout)
-            runtime.record_launch()
-            # async dispatch: the device crunches this batch while the
-            # host folds the previous batch
-            fold.submit(fused(packed_inputs), meta_box)
-        return fold.finish()
+            # per-key builds with error capture: a failing input (e.g. a
+            # predicate over a missing column) fails only the analyzers
+            # that need it — host members individually, the device group
+            # as a whole (reference: AnalysisRunner.scala:310-313)
+            built: Dict[str, np.ndarray] = {}
+            build_errors: Dict[str, BaseException] = {}
+            for key in sorted(specs):
+                try:
+                    built[key] = np.asarray(specs[key].build(batch))
+                except Exception as e:  # noqa: BLE001
+                    build_errors[key] = e
+            if use_device and device_error is None:
+                try:
+                    for key in device_spec_keys:
+                        if key in build_errors:
+                            raise build_errors[key]
+                    padded = _pad_size(batch.num_rows, self.batch_size)
+                    packed_inputs, layout = pack_batch_inputs(
+                        [(k, built[k]) for k in device_spec_keys],
+                        padded, dtype, sticky, num_rows=batch.num_rows,
+                    )
+                    fused, meta_box = get_fused_fn(analyzers, assisted, layout)
+                    runtime.record_launch()
+                    # async dispatch: the device crunches this batch while
+                    # the host folds the previous batch (and the host
+                    # members below)
+                    fold.submit(fused(packed_inputs), meta_box)
+                except Exception as e:  # noqa: BLE001
+                    device_error = e
+            for i, member in host_members:
+                if i in host_errors:
+                    continue
+                try:
+                    for key in host_member_keys[i]:
+                        if key in build_errors:
+                            raise build_errors[key]
+                    agg = _to_f64(member.device_reduce(built, np))
+                    prev = host_aggs.get(i)
+                    host_aggs[i] = (
+                        agg if prev is None else member.merge_agg(prev, agg, np)
+                    )
+                except Exception as e:  # noqa: BLE001
+                    host_errors[i] = e
+
+        aggs, assisted_states = fold.finish() if device_error is None else ([], [])
+        host_results: Dict[int, AnalyzerRunResult] = {}
+        for i, member in host_members:
+            if i in host_errors:
+                host_results[i] = AnalyzerRunResult(member, error=host_errors[i])
+            else:
+                try:
+                    host_results[i] = AnalyzerRunResult(
+                        member, state=member.state_from_aggregates(host_aggs.get(i))
+                    )
+                except Exception as e:  # noqa: BLE001
+                    host_results[i] = AnalyzerRunResult(member, error=e)
+        return aggs, assisted_states, host_results, device_error

@@ -32,6 +32,63 @@ def compute_dtype() -> jnp.dtype:
 MAX_F32_EXACT_COUNT_BATCH = 1 << 24  # f32 integers exact below 2^24
 
 
+# ---------------------------------------------------------------------------
+# Placement: where a reduction earns its bytes
+# ---------------------------------------------------------------------------
+
+_PLACEMENT_CACHE: Optional[str] = None
+# below this measured host->device bandwidth, discrete (mask/code-only)
+# reductions cost more to ship than to fold on the host
+PLACEMENT_BANDWIDTH_FLOOR = 100e6  # bytes/s
+
+
+def measure_device_bandwidth(nbytes: int = 4 << 20) -> float:
+    """One-shot effective H2D+D2H bandwidth probe (synchronized via a
+    value fetch — async dispatch makes un-fetched timings meaningless on
+    tunneled devices)."""
+    import time
+
+    data = np.zeros(nbytes // 4, dtype=np.float32)
+    total = jax.jit(jnp.sum)
+    float(total(data))  # compile + warm
+    start = time.monotonic()
+    float(total(data))
+    elapsed = max(time.monotonic() - start, 1e-9)
+    return nbytes / elapsed
+
+
+def placement_mode() -> str:
+    """'device' (everything in the fused XLA pass) or 'host-discrete'
+    (mask/code-only reductions fold on the host; value reductions stay
+    on device).
+
+    The scheduler analogue of Spark's map-side combine decision: a
+    discrete analyzer consumes ~1-2 bytes/row of masks or dictionary
+    codes and produces a tiny state — when the link to the device moves
+    fewer bytes/s than the host can simply *reduce*, shipping those rows
+    is a loss. Auto-measures once per process; override with
+    DEEQU_TPU_PLACEMENT=device|host|auto.
+    """
+    global _PLACEMENT_CACHE
+    import os
+
+    env = os.environ.get("DEEQU_TPU_PLACEMENT", "auto")
+    if env == "device":
+        return "device"
+    if env == "host":
+        return "host-discrete"
+    if _PLACEMENT_CACHE is None:
+        try:
+            bandwidth = measure_device_bandwidth()
+        except Exception:  # noqa: BLE001 - no device at all -> host
+            _PLACEMENT_CACHE = "host-discrete"
+            return _PLACEMENT_CACHE
+        _PLACEMENT_CACHE = (
+            "device" if bandwidth >= PLACEMENT_BANDWIDTH_FLOOR else "host-discrete"
+        )
+    return _PLACEMENT_CACHE
+
+
 @dataclass
 class ExecutionStats:
     """Counts of engine work during a monitored block."""

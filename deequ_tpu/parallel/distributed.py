@@ -112,12 +112,18 @@ class DistributedScanPass:
         self.batch_size_per_device = batch_size_per_device
 
     def run(self, table: Table) -> List[AnalyzerRunResult]:
+        # same placement policy as FusedScanPass: on a slow device link,
+        # discrete (mask/code-only) analyzers fold on the host while the
+        # mesh reduces the value-dense ones
+        host_discrete = runtime.placement_mode() == "host-discrete"
         merge_analyzers: List[ScanShareableAnalyzer] = []
         merge_idx: List[int] = []
         assisted: List[ScanShareableAnalyzer] = []
         assisted_idx: List[int] = []
+        host_members: List[tuple] = []
         results: Dict[int, AnalyzerRunResult] = {}
         specs: Dict[str, Any] = {}
+        device_keys: set = set()
 
         for i, analyzer in enumerate(self.analyzers):
             try:
@@ -130,9 +136,13 @@ class DistributedScanPass:
             if getattr(analyzer, "device_assisted", False):
                 assisted.append(analyzer)
                 assisted_idx.append(i)
+                device_keys.update(s.key for s in analyzer_specs)
+            elif host_discrete and getattr(analyzer, "discrete_inputs", False):
+                host_members.append((i, analyzer))
             else:
                 merge_analyzers.append(analyzer)
                 merge_idx.append(i)
+                device_keys.update(s.key for s in analyzer_specs)
 
         n_devices = self.mesh.shape[self.axis_name]
         global_batch = self.batch_size_per_device * n_devices
@@ -152,28 +162,47 @@ class DistributedScanPass:
             lambda _: NamedSharding(self.mesh, P(self.axis_name)), specs
         )
 
+        host_aggs: Dict[int, Any] = {}
+        host_errors: Dict[int, BaseException] = {}
         try:
             fold = PipelinedAggFold(merge_analyzers, assisted, n_dev=n_devices)
 
             for batch in table.batches(global_batch):
-                if fn is None:
-                    continue
-                # pad to a multiple of n_devices (pow2 per device shard)
-                per_dev = _pad_size(
-                    -(-batch.num_rows // n_devices), self.batch_size_per_device
-                )
-                padded = per_dev * n_devices
-                inputs: Dict[str, Any] = {}
-                for key, spec in specs.items():
-                    arr = runtime.pad_to(np.asarray(spec.build(batch)), padded)
-                    if not (
-                        arr.dtype == np.bool_
-                        or np.issubdtype(arr.dtype, np.integer)
-                    ):
-                        arr = arr.astype(dtype)
-                    inputs[key] = jax.device_put(arr, in_sharding[key])
-                runtime.record_launch()
-                fold.submit(fn(inputs))
+                built: Dict[str, np.ndarray] = {
+                    key: np.asarray(spec.build(batch))
+                    for key, spec in specs.items()
+                }
+                if fn is not None:
+                    # pad to a multiple of n_devices (pow2 per device shard)
+                    per_dev = _pad_size(
+                        -(-batch.num_rows // n_devices), self.batch_size_per_device
+                    )
+                    padded = per_dev * n_devices
+                    inputs: Dict[str, Any] = {}
+                    for key in device_keys:
+                        arr = runtime.pad_to(built[key], padded)
+                        if not (
+                            arr.dtype == np.bool_
+                            or np.issubdtype(arr.dtype, np.integer)
+                        ):
+                            arr = arr.astype(dtype)
+                        inputs[key] = jax.device_put(arr, in_sharding[key])
+                    runtime.record_launch()
+                    fold.submit(fn(inputs))
+                for i, member in host_members:
+                    if i in host_errors:
+                        continue
+                    try:
+                        agg = jax.tree_util.tree_map(
+                            lambda x: np.asarray(x, dtype=np.float64),
+                            member.device_reduce(built, np),
+                        )
+                        prev = host_aggs.get(i)
+                        host_aggs[i] = (
+                            agg if prev is None else member.merge_agg(prev, agg, np)
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        host_errors[i] = e
             aggs, assisted_states = fold.finish()
             for i, analyzer, agg in zip(merge_idx, merge_analyzers, aggs):
                 results[i] = AnalyzerRunResult(
@@ -181,9 +210,16 @@ class DistributedScanPass:
                 )
             for i, state in zip(assisted_idx, assisted_states):
                 results[i] = AnalyzerRunResult(self.analyzers[i], state=state)
+            for i, member in host_members:
+                if i in host_errors:
+                    results[i] = AnalyzerRunResult(member, error=host_errors[i])
+                else:
+                    results[i] = AnalyzerRunResult(
+                        member, state=member.state_from_aggregates(host_aggs.get(i))
+                    )
         except Exception as e:  # noqa: BLE001
-            for i in merge_idx + assisted_idx:
-                results[i] = AnalyzerRunResult(self.analyzers[i], error=e)
+            for i in range(len(self.analyzers)):
+                results.setdefault(i, AnalyzerRunResult(self.analyzers[i], error=e))
 
         return [results[i] for i in range(len(self.analyzers))]
 

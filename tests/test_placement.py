@@ -1,0 +1,141 @@
+"""Placement: discrete analyzers fold on the host when the device link
+is slow (runtime.placement_mode), with results identical to the fused
+device pass — the scheduler analogue of Spark's map-side combine
+decision (SURVEY.md §2.10; reference: runners/AnalysisRunner.scala:279-326
+runs everything through Spark, where the data already lives next to the
+executors — here the engine must *choose* where the bytes go)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Completeness,
+    Compliance,
+    DataType,
+    Maximum,
+    Mean,
+    Minimum,
+    PatternMatch,
+    Size,
+    StandardDeviation,
+    Sum,
+)
+from deequ_tpu.analyzers.sketch import ApproxQuantile
+from deequ_tpu.data.table import Table
+from deequ_tpu.ops import runtime
+from deequ_tpu.ops.fused import FusedScanPass
+
+
+@pytest.fixture
+def mixed_table():
+    rng = np.random.default_rng(42)
+    x = rng.normal(10.0, 3.0, 5000)
+    x[::7] = np.nan
+    return Table.from_numpy(
+        {
+            "x": x,
+            "n": rng.integers(0, 1000, 5000),
+            "s": np.array(
+                [["alpha", "42", "3.14", "true", None][i % 5] for i in range(5000)],
+                dtype=object,
+            ),
+        }
+    )
+
+
+ANALYZERS = [
+    Size(),
+    Size(where="n > 500"),
+    Completeness("x"),
+    Completeness("x", where="n > 500"),
+    Compliance("big n", "n >= 100"),
+    PatternMatch("s", r"^\d+$"),
+    ApproxCountDistinct("n"),
+    ApproxCountDistinct("s"),
+    DataType("s"),
+    # non-discrete members stay on device alongside
+    Mean("x"),
+    Minimum("x"),
+    Maximum("x"),
+    Sum("x"),
+    StandardDeviation("x"),
+    ApproxQuantile("x", 0.5),
+]
+
+
+def _metrics(table, placement, monkeypatch):
+    monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+    results = FusedScanPass(ANALYZERS, batch_size=1024).run(table)
+    out = {}
+    for r in results:
+        state = r.state_or_raise()
+        out[repr(r.analyzer)] = r.analyzer.compute_metric_from(state).value.get()
+    return out
+
+
+def test_host_placement_matches_device(mixed_table, monkeypatch):
+    device = _metrics(mixed_table, "device", monkeypatch)
+    host = _metrics(mixed_table, "host", monkeypatch)
+    assert device.keys() == host.keys()
+    for key in device:
+        if key.startswith("ApproxQuantile"):
+            # the KLL sketch draws fresh per-batch compaction seeds each
+            # run; both values are within the declared rank error, not
+            # bit-identical across two executions
+            assert device[key] == pytest.approx(host[key], rel=0.05), key
+        else:
+            assert device[key] == pytest.approx(host[key], rel=1e-12), key
+
+
+def test_host_placement_skips_device_for_all_discrete(mixed_table, monkeypatch):
+    monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "host")
+    discrete_only = [a for a in ANALYZERS if getattr(a, "discrete_inputs", False)]
+    with runtime.monitored() as stats:
+        results = FusedScanPass(discrete_only, batch_size=1024).run(mixed_table)
+    assert all(r.error is None for r in results)
+    # still ONE logical pass over the data, but zero device launches
+    assert stats.device_passes == 1
+    assert stats.device_launches == 0
+
+
+def test_host_placement_isolates_failures(mixed_table, monkeypatch):
+    monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "host")
+    results = FusedScanPass(
+        [Completeness("x"), Compliance("bad", "nonexistent_col > 1"), Size()],
+        batch_size=1024,
+    ).run(mixed_table)
+    assert results[0].error is None
+    assert results[1].error is not None  # fails alone
+    assert results[2].error is None
+
+
+def test_distributed_host_placement_parity(monkeypatch):
+    import jax
+    from deequ_tpu.parallel.distributed import data_mesh, run_distributed_analysis
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    mesh8 = data_mesh()
+
+    rng = np.random.default_rng(7)
+    table = Table.from_numpy(
+        {"x": rng.normal(size=4000), "g": rng.integers(0, 30, 4000)}
+    )
+    analyzers = [
+        Size(),
+        Completeness("x"),
+        ApproxCountDistinct("g"),
+        Mean("x"),
+        StandardDeviation("x"),
+    ]
+    monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "device")
+    dev = run_distributed_analysis(table, analyzers, mesh=mesh8)
+    monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "host")
+    host = run_distributed_analysis(table, analyzers, mesh=mesh8)
+    for a in analyzers:
+        assert dev.metric_map[a].value.get() == pytest.approx(
+            host.metric_map[a].value.get(), rel=1e-12
+        ), a
