@@ -370,8 +370,9 @@ class FusedScanPass:
             try:
                 aggs, assisted_states, host_results, device_error = self._run_pass(
                     table, merge_analyzers, specs, assisted,
-                    device_keys, host_members,
+                    device_keys, host_members, host_keys,
                 )
+                results.update(host_results)  # host outcomes stand on their own
                 if device_error is not None:
                     # a runtime failure of the shared device program fails
                     # every analyzer IN that program; host-folded members
@@ -383,14 +384,16 @@ class FusedScanPass:
                         )
                 else:
                     for i, analyzer, agg in zip(merge_idx, merge_analyzers, aggs):
-                        results[i] = AnalyzerRunResult(
-                            analyzer, state=analyzer.state_from_aggregates(agg)
-                        )
+                        try:
+                            results[i] = AnalyzerRunResult(
+                                analyzer, state=analyzer.state_from_aggregates(agg)
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            results[i] = AnalyzerRunResult(analyzer, error=e)
                     for i, analyzer, state in zip(
                         assisted_idx, assisted, assisted_states
                     ):
                         results[i] = AnalyzerRunResult(analyzer, state=state)
-                results.update(host_results)
             except Exception as e:  # noqa: BLE001
                 for i in merge_idx + assisted_idx + host_idx:
                     results.setdefault(i, AnalyzerRunResult(self.analyzers[i], error=e))
@@ -405,6 +408,7 @@ class FusedScanPass:
         assisted=(),
         device_keys=None,
         host_members=(),
+        host_member_keys=None,
     ):
         dtype = runtime.compute_dtype()
         if (
@@ -436,18 +440,30 @@ class FusedScanPass:
         host_errors: Dict[int, BaseException] = {}
         device_error: Optional[BaseException] = None
 
-        host_member_keys = {
-            i: [s.key for s in member.input_specs()] for i, member in host_members
-        }
+        if host_member_keys is None:
+            host_member_keys = {
+                i: [s.key for s in member.input_specs()] for i, member in host_members
+            }
         sticky: Dict[str, Any] = {}
         for batch in table.batches(self.batch_size):
             # per-key builds with error capture: a failing input (e.g. a
             # predicate over a missing column) fails only the analyzers
             # that need it — host members individually, the device group
-            # as a whole (reference: AnalysisRunner.scala:310-313)
+            # as a whole (reference: AnalysisRunner.scala:310-313).
+            # Only keys with a still-live consumer are built at all.
+            live_keys: set = set()
+            if use_device and device_error is None:
+                live_keys.update(device_spec_keys)
+            for i, _member in host_members:
+                if i not in host_errors:
+                    live_keys.update(host_member_keys[i])
+            device_live = use_device and device_error is None
+            host_live = any(i not in host_errors for i, _m in host_members)
+            if not device_live and not host_live:
+                break  # everything already failed; stop scanning
             built: Dict[str, np.ndarray] = {}
             build_errors: Dict[str, BaseException] = {}
-            for key in sorted(specs):
+            for key in sorted(live_keys):
                 try:
                     built[key] = np.asarray(specs[key].build(batch))
                 except Exception as e:  # noqa: BLE001
@@ -485,7 +501,14 @@ class FusedScanPass:
                 except Exception as e:  # noqa: BLE001
                     host_errors[i] = e
 
-        aggs, assisted_states = fold.finish() if device_error is None else ([], [])
+        aggs, assisted_states = [], []
+        if device_error is None:
+            try:
+                # the final device_get lives here: an execution/transfer
+                # failure surfaces now and must not erase host outcomes
+                aggs, assisted_states = fold.finish()
+            except Exception as e:  # noqa: BLE001
+                device_error = e
         host_results: Dict[int, AnalyzerRunResult] = {}
         for i, member in host_members:
             if i in host_errors:

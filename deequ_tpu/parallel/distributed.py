@@ -26,7 +26,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deequ_tpu.analyzers.base import ScanShareableAnalyzer
 from deequ_tpu.data.table import Table
 from deequ_tpu.ops import runtime
-from deequ_tpu.ops.fused import AnalyzerRunResult, PipelinedAggFold, _pad_size
+from deequ_tpu.ops.fused import (
+    AnalyzerRunResult,
+    PipelinedAggFold,
+    _pad_size,
+    _to_f64,
+)
 
 DATA_AXIS = "data"
 
@@ -167,56 +172,97 @@ class DistributedScanPass:
         try:
             fold = PipelinedAggFold(merge_analyzers, assisted, n_dev=n_devices)
 
+            host_member_keys = {
+                i: [s.key for s in member.input_specs()]
+                for i, member in host_members
+            }
+            device_error: Any = None
             for batch in table.batches(global_batch):
-                built: Dict[str, np.ndarray] = {
-                    key: np.asarray(spec.build(batch))
-                    for key, spec in specs.items()
-                }
-                if fn is not None:
-                    # pad to a multiple of n_devices (pow2 per device shard)
-                    per_dev = _pad_size(
-                        -(-batch.num_rows // n_devices), self.batch_size_per_device
-                    )
-                    padded = per_dev * n_devices
-                    inputs: Dict[str, Any] = {}
-                    for key in device_keys:
-                        arr = runtime.pad_to(built[key], padded)
-                        if not (
-                            arr.dtype == np.bool_
-                            or np.issubdtype(arr.dtype, np.integer)
-                        ):
-                            arr = arr.astype(dtype)
-                        inputs[key] = jax.device_put(arr, in_sharding[key])
-                    runtime.record_launch()
-                    fold.submit(fn(inputs))
+                # per-key builds with error capture — same isolation
+                # contract as FusedScanPass._run_pass
+                built: Dict[str, np.ndarray] = {}
+                build_errors: Dict[str, BaseException] = {}
+                live_keys: set = set()
+                if fn is not None and device_error is None:
+                    live_keys.update(device_keys)
+                for i, _m in host_members:
+                    if i not in host_errors:
+                        live_keys.update(host_member_keys[i])
+                for key in sorted(live_keys):
+                    try:
+                        built[key] = np.asarray(specs[key].build(batch))
+                    except Exception as e:  # noqa: BLE001
+                        build_errors[key] = e
+                if fn is not None and device_error is None:
+                    try:
+                        for key in device_keys:
+                            if key in build_errors:
+                                raise build_errors[key]
+                        # pad to a multiple of n_devices (pow2 per shard)
+                        per_dev = _pad_size(
+                            -(-batch.num_rows // n_devices),
+                            self.batch_size_per_device,
+                        )
+                        padded = per_dev * n_devices
+                        inputs: Dict[str, Any] = {}
+                        for key in device_keys:
+                            arr = runtime.pad_to(built[key], padded)
+                            if not (
+                                arr.dtype == np.bool_
+                                or np.issubdtype(arr.dtype, np.integer)
+                            ):
+                                arr = arr.astype(dtype)
+                            inputs[key] = jax.device_put(arr, in_sharding[key])
+                        runtime.record_launch()
+                        fold.submit(fn(inputs))
+                    except Exception as e:  # noqa: BLE001
+                        device_error = e
                 for i, member in host_members:
                     if i in host_errors:
                         continue
                     try:
-                        agg = jax.tree_util.tree_map(
-                            lambda x: np.asarray(x, dtype=np.float64),
-                            member.device_reduce(built, np),
-                        )
+                        for key in host_member_keys[i]:
+                            if key in build_errors:
+                                raise build_errors[key]
+                        agg = _to_f64(member.device_reduce(built, np))
                         prev = host_aggs.get(i)
                         host_aggs[i] = (
                             agg if prev is None else member.merge_agg(prev, agg, np)
                         )
                     except Exception as e:  # noqa: BLE001
                         host_errors[i] = e
-            aggs, assisted_states = fold.finish()
-            for i, analyzer, agg in zip(merge_idx, merge_analyzers, aggs):
-                results[i] = AnalyzerRunResult(
-                    analyzer, state=analyzer.state_from_aggregates(agg)
-                )
-            for i, state in zip(assisted_idx, assisted_states):
-                results[i] = AnalyzerRunResult(self.analyzers[i], state=state)
+            aggs, assisted_states = [], []
+            if device_error is None:
+                try:
+                    aggs, assisted_states = fold.finish()
+                except Exception as e:  # noqa: BLE001
+                    device_error = e
+            if device_error is not None:
+                for i in merge_idx + assisted_idx:
+                    results[i] = AnalyzerRunResult(
+                        self.analyzers[i], error=device_error
+                    )
+            else:
+                for i, analyzer, agg in zip(merge_idx, merge_analyzers, aggs):
+                    try:
+                        results[i] = AnalyzerRunResult(
+                            analyzer, state=analyzer.state_from_aggregates(agg)
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        results[i] = AnalyzerRunResult(analyzer, error=e)
+                for i, state in zip(assisted_idx, assisted_states):
+                    results[i] = AnalyzerRunResult(self.analyzers[i], state=state)
             for i, member in host_members:
                 if i in host_errors:
                     results[i] = AnalyzerRunResult(member, error=host_errors[i])
                 else:
-                    results[i] = AnalyzerRunResult(
-                        member, state=member.state_from_aggregates(host_aggs.get(i))
-                    )
+                    try:
+                        results[i] = AnalyzerRunResult(
+                            member,
+                            state=member.state_from_aggregates(host_aggs.get(i)),
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        results[i] = AnalyzerRunResult(member, error=e)
         except Exception as e:  # noqa: BLE001
             for i in range(len(self.analyzers)):
                 results.setdefault(i, AnalyzerRunResult(self.analyzers[i], error=e))
