@@ -126,6 +126,7 @@ class DistributedScanPass:
         assisted: List[ScanShareableAnalyzer] = []
         assisted_idx: List[int] = []
         host_members: List[tuple] = []
+        host_member_keys: Dict[int, List[str]] = {}
         results: Dict[int, AnalyzerRunResult] = {}
         specs: Dict[str, Any] = {}
         device_keys: set = set()
@@ -144,6 +145,7 @@ class DistributedScanPass:
                 device_keys.update(s.key for s in analyzer_specs)
             elif host_discrete and getattr(analyzer, "discrete_inputs", False):
                 host_members.append((i, analyzer))
+                host_member_keys[i] = [s.key for s in analyzer_specs]
             else:
                 merge_analyzers.append(analyzer)
                 merge_idx.append(i)
@@ -172,10 +174,6 @@ class DistributedScanPass:
         try:
             fold = PipelinedAggFold(merge_analyzers, assisted, n_dev=n_devices)
 
-            host_member_keys = {
-                i: [s.key for s in member.input_specs()]
-                for i, member in host_members
-            }
             device_error: Any = None
             for batch in table.batches(global_batch):
                 # per-key builds with error capture — same isolation
@@ -183,11 +181,16 @@ class DistributedScanPass:
                 built: Dict[str, np.ndarray] = {}
                 build_errors: Dict[str, BaseException] = {}
                 live_keys: set = set()
-                if fn is not None and device_error is None:
+                device_live = fn is not None and device_error is None
+                if device_live:
                     live_keys.update(device_keys)
+                host_live = False
                 for i, _m in host_members:
                     if i not in host_errors:
+                        host_live = True
                         live_keys.update(host_member_keys[i])
+                if not device_live and not host_live:
+                    break  # everything already failed; stop scanning
                 for key in sorted(live_keys):
                     try:
                         built[key] = np.asarray(specs[key].build(batch))

@@ -7,6 +7,7 @@ from deequ_tpu.suggestions.rules import (
     NonNegativeNumbersRule,
     RetainCompletenessRule,
     RetainTypeRule,
+    Rules,
     UniqueIfApproximatelyUniqueRule,
 )
 from deequ_tpu.suggestions.suggestion import ConstraintSuggestion
@@ -14,10 +15,6 @@ from deequ_tpu.suggestions.runner import (
     ConstraintSuggestionResult,
     ConstraintSuggestionRunner,
 )
-
-
-class Rules:
-    DEFAULT = DEFAULT_RULES
 
 
 __all__ = [

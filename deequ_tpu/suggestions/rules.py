@@ -308,3 +308,12 @@ def DEFAULT_RULES() -> List[ConstraintRule]:
         FractionalCategoricalRangeRule(),
         NonNegativeNumbersRule(),
     ]
+
+
+class Rules:
+    """Reference-shaped access: `Rules.DEFAULT`
+    (reference: suggestions/ConstraintSuggestionRunner.scala:29-35).
+    Rules are stateless, so sharing the instances is safe; the tuple
+    keeps the default set immutable."""
+
+    DEFAULT = tuple(DEFAULT_RULES())

@@ -63,12 +63,25 @@ class VerificationSuite:
             metrics_repository=metrics_repository,
             reuse_existing_results_for_key=reuse_existing_results_for_key,
             fail_if_results_missing=fail_if_results_missing,
-            save_or_append_results_with_key=save_or_append_results_with_key,
+            # NOT forwarded: results are saved AFTER check evaluation, so
+            # anomaly-check assertions querying the repository see only
+            # prior history, not this run's own metrics
+            # (reference: VerificationSuite.scala:121-139 passes
+            # saveOrAppendResultsWithKey = None into the runner and saves
+            # post-evaluate)
+            save_or_append_results_with_key=None,
             engine=engine,
             mesh=mesh,
         )
 
-        return VerificationSuite.evaluate(checks, analysis_results)
+        verification_result = VerificationSuite.evaluate(checks, analysis_results)
+
+        if metrics_repository is not None and save_or_append_results_with_key is not None:
+            AnalysisRunner._save_or_append(
+                metrics_repository, save_or_append_results_with_key, analysis_results
+            )
+
+        return verification_result
 
     @staticmethod
     def run_on_aggregated_states(

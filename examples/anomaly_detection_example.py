@@ -1,0 +1,68 @@
+"""Anomaly check on metric history
+(reference: examples/AnomalyDetectionExample.scala:29-92).
+
+We compute the Size metric every 'day'; today's data more than doubled in
+size, so a RateOfChangeStrategy(max_rate_increase=2.0) anomaly check fails
+the verification.
+"""
+
+import time
+
+from example_utils import Item, items_as_table
+
+from deequ_tpu import CheckStatus, VerificationSuite
+from deequ_tpu.analyzers import Size
+from deequ_tpu.anomaly.strategies import RateOfChangeStrategy
+from deequ_tpu.repository.base import ResultKey
+from deequ_tpu.repository.memory import InMemoryMetricsRepository
+
+
+def main() -> None:
+    metrics_repository = InMemoryMetricsRepository()
+    now_ms = int(time.time() * 1000)
+
+    # Yesterday, the data had only two rows
+    yesterdays_key = ResultKey(now_ms - 24 * 60 * 1000)
+    yesterdays_dataset = items_as_table(
+        Item(1, "Thingy A", "awesome thing.", "high", 0),
+        Item(2, "Thingy B", "available at http://thingb.com", None, 0),
+    )
+    (
+        VerificationSuite()
+        .on_data(yesterdays_dataset)
+        .use_repository(metrics_repository)
+        .save_or_append_result(yesterdays_key)
+        .add_anomaly_check(RateOfChangeStrategy(max_rate_increase=2.0), Size())
+        .run()
+    )
+
+    # Today the data has five rows — more than doubled
+    todays_dataset = items_as_table(
+        Item(1, "Thingy A", "awesome thing.", "high", 0),
+        Item(2, "Thingy B", "available at http://thingb.com", None, 0),
+        Item(3, None, None, "low", 5),
+        Item(4, "Thingy D", "checkout https://thingd.ca", "low", 10),
+        Item(5, "Thingy E", None, "high", 12),
+    )
+    todays_key = ResultKey(now_ms)
+    verification_result = (
+        VerificationSuite()
+        .on_data(todays_dataset)
+        .use_repository(metrics_repository)
+        .save_or_append_result(todays_key)
+        .add_anomaly_check(RateOfChangeStrategy(max_rate_increase=2.0), Size())
+        .run()
+    )
+
+    if verification_result.status != CheckStatus.SUCCESS:
+        print("Anomaly detected in the Size() metric!")
+        for row in (
+            metrics_repository.load()
+            .for_analyzers([Size()])
+            .get_success_metrics_as_rows()
+        ):
+            print(row)
+
+
+if __name__ == "__main__":
+    main()

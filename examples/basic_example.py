@@ -1,0 +1,66 @@
+"""The canonical Item demo from the reference README.
+
+Expected outcome (reference: README.md:113-119, examples/BasicExample.scala):
+the error-level check fails on Completeness(name)=0.8, the warning-level
+check fails on containsURL(description)=0.4 — the run reports the failed
+constraints.
+"""
+
+from example_utils import Item, items_as_table
+
+from deequ_tpu import Check, CheckLevel, CheckStatus, VerificationSuite
+from deequ_tpu.constraints.constraint import ConstraintStatus
+
+
+def main() -> None:
+    data = items_as_table(
+        Item(1, "Thingy A", "awesome thing.", "high", 0),
+        Item(2, "Thingy B", "available at http://thingb.com", None, 0),
+        Item(3, None, None, "low", 5),
+        Item(4, "Thingy D", "checkout https://thingd.ca", "low", 10),
+        Item(5, "Thingy E", None, "high", 12),
+    )
+
+    verification_result = (
+        VerificationSuite()
+        .on_data(data)
+        .add_check(
+            Check(CheckLevel.ERROR, "integrity checks")
+            # we expect 5 records
+            .has_size(lambda size: size == 5)
+            # 'id' should never be NULL
+            .is_complete("id")
+            # 'id' should not contain duplicates
+            .is_unique("id")
+            # 'name' should never be NULL
+            .is_complete("name")
+            # 'priority' should only contain the values "high" and "low"
+            .is_contained_in("priority", ["high", "low"])
+            # 'numViews' should not contain negative values
+            .is_non_negative("numViews")
+        )
+        .add_check(
+            Check(CheckLevel.WARNING, "distribution checks")
+            # at least half of the 'description's should contain a url
+            .contains_url("description", lambda ratio: ratio >= 0.5)
+            # half of the items should have less than 10 'numViews'
+            .has_approx_quantile("numViews", 0.5, lambda median: median <= 10)
+        )
+        .run()
+    )
+
+    if verification_result.status == CheckStatus.SUCCESS:
+        print("The data passed the test, everything is fine!")
+    else:
+        print(
+            "We found errors in the data, the following constraints were "
+            "not satisfied:\n"
+        )
+        for check_result in verification_result.check_results.values():
+            for result in check_result.constraint_results:
+                if result.status != ConstraintStatus.SUCCESS:
+                    print(f"{result.constraint} failed: {result.message}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Automatic constraint suggestion from a profile
+(reference: examples/ConstraintSuggestionExample.scala:26-70).
+
+Profiles the data, applies the default rule set, and prints each
+suggested constraint with its generated code string.
+"""
+
+import numpy as np
+
+from example_utils import Table  # noqa: F401  (path bootstrap)
+
+from deequ_tpu import Table
+from deequ_tpu.suggestions.rules import Rules
+from deequ_tpu.suggestions.runner import ConstraintSuggestionRunner
+
+
+def main() -> None:
+    data = Table.from_numpy(
+        {
+            "name": np.array(
+                ["thingA", "thingA", "thingB", "thingC", "thingD", "thingC",
+                 "thingC", "thingE"] * 2,
+                dtype=object,
+            ),
+            "count": np.array(
+                ["13.0", "5", None, None, "1.0", "7.0", "24", "20",
+                 "13.0", "5", None, None, "1.0", "17.0", "22", "23"],
+                dtype=object,
+            ),
+            "status": np.array(
+                ["IN_TRANSIT", "DELAYED", "DELAYED", "IN_TRANSIT", "DELAYED",
+                 "UNKNOWN", "UNKNOWN", "DELAYED"] * 2,
+                dtype=object,
+            ),
+            "valuable": np.array(
+                ["true", "false", None, "false", "true", None, None, "false"] * 2,
+                dtype=object,
+            ),
+        }
+    )
+
+    suggestion_result = (
+        ConstraintSuggestionRunner()
+        .on_data(data)
+        .add_constraint_rules(Rules.DEFAULT)
+        .run()
+    )
+
+    # Heuristic suggestions: always review before deploying
+    for column, suggestions in suggestion_result.constraint_suggestions.items():
+        for suggestion in suggestions:
+            print(
+                f"Constraint suggestion for '{column}':\t{suggestion.description}\n"
+                f"The corresponding code is {suggestion.code_for_constraint}\n"
+            )
+
+
+if __name__ == "__main__":
+    main()

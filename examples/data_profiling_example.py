@@ -1,0 +1,74 @@
+"""Single-line profiling of raw (mostly string) data
+(reference: examples/DataProfilingExample.scala:26-77).
+
+The profiler runs its three passes, infers that the string column 'count'
+is numeric, and computes full descriptive statistics plus value
+distributions for low-cardinality columns.
+"""
+
+import numpy as np
+
+from example_utils import Table  # noqa: F401  (path bootstrap)
+
+from deequ_tpu import Table
+from deequ_tpu.profiles.column_profile import NumericColumnProfile
+from deequ_tpu.profiles.runner import ColumnProfilerRunner
+
+
+def raw_data() -> Table:
+    """reference: DataProfilingExample.scala:28-40 (RawData rows)."""
+    return Table.from_numpy(
+        {
+            "name": np.array(
+                ["thingA", "thingA", "thingB", "thingC", "thingD", "thingC",
+                 "thingC", "thingE"],
+                dtype=object,
+            ),
+            "count": np.array(
+                ["13.0", "5", None, None, "1.0", "7.0", "20", "20"], dtype=object
+            ),
+            "status": np.array(
+                ["IN_TRANSIT", "DELAYED", "DELAYED", "IN_TRANSIT", "DELAYED",
+                 "UNKNOWN", "UNKNOWN", "DELAYED"],
+                dtype=object,
+            ),
+            "valuable": np.array(
+                ["true", "false", None, "false", "true", None, None, "false"],
+                dtype=object,
+            ),
+        }
+    )
+
+
+def main() -> None:
+    result = ColumnProfilerRunner().on_data(raw_data()).run()
+
+    for name, profile in result.profiles.items():
+        print(
+            f"Column '{name}':\n"
+            f"\tcompleteness: {profile.completeness}\n"
+            f"\tapproximate number of distinct values: "
+            f"{profile.approximate_num_distinct_values}\n"
+            f"\tdatatype: {profile.data_type}\n"
+        )
+
+    count_profile = result.profiles["count"]
+    assert isinstance(count_profile, NumericColumnProfile)
+    print(
+        "Statistics of 'count':\n"
+        f"\tminimum: {count_profile.minimum}\n"
+        f"\tmaximum: {count_profile.maximum}\n"
+        f"\tmean: {count_profile.mean}\n"
+        f"\tstandard deviation: {count_profile.std_dev}\n"
+    )
+
+    status_profile = result.profiles["status"]
+    print("Value distribution in 'status':")
+    if status_profile.histogram is not None:
+        for key, entry in status_profile.histogram.values.items():
+            print(f"\t{key} occurred {int(entry.absolute)} times "
+                  f"(ratio is {entry.ratio})")
+
+
+if __name__ == "__main__":
+    main()

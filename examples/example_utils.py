@@ -1,0 +1,66 @@
+"""Shared helpers for the runnable examples.
+
+The reference ships Item/Manufacturer case classes and a local-SparkSession
+loan pattern (reference: examples/ExampleUtils.scala:23-47,
+examples/entities.scala:19-31). Here a Table is built directly from the
+entity tuples — there is no session to manage; JAX owns the device.
+
+Run any example from the repo root:  python examples/basic_example.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass
+from typing import Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from deequ_tpu import Table  # noqa: E402
+
+
+@dataclass
+class Item:
+    """reference: examples/entities.scala:19-25."""
+
+    id: int
+    name: Optional[str]
+    description: Optional[str]
+    priority: Optional[str]
+    numViews: int
+
+
+@dataclass
+class Manufacturer:
+    """reference: examples/entities.scala:27-31."""
+
+    id: int
+    name: Optional[str]
+    countryCode: Optional[str]
+
+
+def items_as_table(*items: Item) -> Table:
+    """reference: ExampleUtils.itemsAsDataframe (ExampleUtils.scala:39-42)."""
+    return Table.from_numpy(
+        {
+            "id": np.array([it.id for it in items], dtype=np.int64),
+            "name": np.array([it.name for it in items], dtype=object),
+            "description": np.array([it.description for it in items], dtype=object),
+            "priority": np.array([it.priority for it in items], dtype=object),
+            "numViews": np.array([it.numViews for it in items], dtype=np.int64),
+        }
+    )
+
+
+def manufacturers_as_table(*ms: Manufacturer) -> Table:
+    """reference: ExampleUtils.manufacturersAsDataframe (ExampleUtils.scala:44-46)."""
+    return Table.from_numpy(
+        {
+            "id": np.array([m.id for m in ms], dtype=np.int64),
+            "name": np.array([m.name for m in ms], dtype=object),
+            "countryCode": np.array([m.countryCode for m in ms], dtype=object),
+        }
+    )

@@ -1,0 +1,77 @@
+"""Storing and querying computed metrics in a repository
+(reference: examples/MetricsRepositoryExample.scala:29-90).
+
+Metrics land in a JSON file on disk (the FileSystem repository also
+serves object storage paths), keyed by timestamp + tags, and are queried
+back by key, time window, and tag value.
+"""
+
+import tempfile
+import time
+from pathlib import Path
+
+from example_utils import Item, items_as_table
+
+from deequ_tpu import Check, CheckLevel, VerificationSuite
+from deequ_tpu.analyzers import Completeness
+from deequ_tpu.repository.base import ResultKey
+from deequ_tpu.repository.fs import FileSystemMetricsRepository
+
+
+def main() -> None:
+    data = items_as_table(
+        Item(1, "Thingy A", "awesome thing.", "high", 0),
+        Item(2, "Thingy B", "available at http://thingb.com", None, 0),
+        Item(3, None, None, "low", 5),
+        Item(4, "Thingy D", "checkout https://thingd.ca", "low", 10),
+        Item(5, "Thingy E", None, "high", 12),
+    )
+
+    # A json file in which the computed metrics will be stored
+    metrics_file = str(Path(tempfile.mkdtemp()) / "metrics.json")
+    repository = FileSystemMetricsRepository(metrics_file)
+
+    # The key under which we store the results: a timestamp plus
+    # arbitrary key-value tags
+    now_ms = int(time.time() * 1000)
+    result_key = ResultKey(now_ms, {"tag": "repositoryExample"})
+
+    (
+        VerificationSuite()
+        .on_data(data)
+        .add_check(
+            Check(CheckLevel.ERROR, "integrity checks")
+            .has_size(lambda size: size == 5)
+            .is_complete("id")
+            .is_complete("name")
+            .is_contained_in("priority", ["high", "low"])
+            .is_non_negative("numViews")
+        )
+        .use_repository(repository)
+        .save_or_append_result(result_key)
+        .run()
+    )
+
+    # Load the metric for a particular analyzer stored under our key
+    completeness_of_name = (
+        repository.load_by_key(result_key).metric(Completeness("name")).value.get()
+    )
+    print(f"The completeness of the name column is: {completeness_of_name}")
+
+    # Query the repository for all metrics from the last 10 minutes as json
+    json_metrics = (
+        repository.load().after(now_ms - 10 * 60 * 1000).get_success_metrics_as_json()
+    )
+    print(f"Metrics from the last 10 minutes:\n{json_metrics}")
+
+    # Query by tag value; the row form is the DataFrame analogue
+    for row in (
+        repository.load()
+        .with_tag_values({"tag": "repositoryExample"})
+        .get_success_metrics_as_rows()
+    ):
+        print(row)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Partitioned-data metrics via per-partition states
+(reference: examples/UpdateMetricsOnPartitionedDataExample.scala:30-95).
+
+States are computed per partition; table-level metrics come from merging
+the states — no data scan. When one partition changes, only its state is
+recomputed and the table metrics re-merged.
+"""
+
+from example_utils import Manufacturer, manufacturers_as_table
+
+from deequ_tpu import Check, CheckLevel
+from deequ_tpu.analyzers.state_provider import InMemoryStateProvider
+from deequ_tpu.runners.analysis_runner import AnalysisRunner
+
+
+def main() -> None:
+    # a manufacturers table partitioned by country code
+    de = manufacturers_as_table(
+        Manufacturer(1, "ManufacturerA", "DE"),
+        Manufacturer(2, "ManufacturerB", "DE"),
+    )
+    us = manufacturers_as_table(
+        Manufacturer(3, "ManufacturerD", "US"),
+        Manufacturer(4, "ManufacturerE", "US"),
+        Manufacturer(5, "ManufacturerF", "US"),
+    )
+    cn = manufacturers_as_table(
+        Manufacturer(6, "ManufacturerG", "CN"),
+        Manufacturer(7, "ManufacturerH", "CN"),
+    )
+
+    # constraints over the table as a WHOLE
+    check = (
+        Check(CheckLevel.WARNING, "a check")
+        .is_complete("name")
+        .contains_url("name", lambda ratio: ratio == 0.0)
+        .is_contained_in("countryCode", ["DE", "US", "CN"])
+    )
+    analyzers = sorted(check.required_analyzers(), key=repr)
+
+    # compute and store the state per partition
+    de_states, us_states, cn_states = (
+        InMemoryStateProvider(),
+        InMemoryStateProvider(),
+        InMemoryStateProvider(),
+    )
+    AnalysisRunner.do_analysis_run(de, analyzers, save_states_with=de_states)
+    AnalysisRunner.do_analysis_run(us, analyzers, save_states_with=us_states)
+    AnalysisRunner.do_analysis_run(cn, analyzers, save_states_with=cn_states)
+
+    # table-level metrics purely from the partition states (no data scan)
+    table_metrics = AnalysisRunner.run_on_aggregated_states(
+        de, analyzers, [de_states, us_states, cn_states]
+    )
+    print("Metrics for the whole table:\n")
+    for analyzer, metric in table_metrics.metric_map.items():
+        print(f"\t{analyzer!r}: {metric.value.get()}")
+
+    # a single partition changes: recompute ONLY its state
+    updated_us = manufacturers_as_table(
+        Manufacturer(3, "ManufacturerDNew", "US"),
+        Manufacturer(4, None, "US"),
+        Manufacturer(5, "ManufacturerFNew http://clickme.com", "US"),
+    )
+    updated_us_states = InMemoryStateProvider()
+    AnalysisRunner.do_analysis_run(
+        updated_us, analyzers, save_states_with=updated_us_states
+    )
+
+    updated_table_metrics = AnalysisRunner.run_on_aggregated_states(
+        de, analyzers, [de_states, updated_us_states, cn_states]
+    )
+    print("Metrics for the whole table after updating the US partition:\n")
+    for analyzer, metric in updated_table_metrics.metric_map.items():
+        print(f"\t{analyzer!r}: {metric.value.get()}")
+
+
+if __name__ == "__main__":
+    main()

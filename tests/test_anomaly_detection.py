@@ -213,3 +213,46 @@ class TestAnomalyCheckIntegration:
             .run()
         )
         assert result.status == CheckStatus.ERROR
+
+
+def test_anomaly_check_does_not_see_current_runs_own_metric():
+    """Results are saved AFTER check evaluation: the anomaly assertion's
+    history query must not include this run's own metric (reference:
+    VerificationSuite.scala:121-139 passes saveOrAppendResultsWithKey=None
+    into the runner and saves post-evaluate). With the wrong order, the
+    2->5 size jump in AnomalyDetectionExample is invisible (diff 0)."""
+    import numpy as np
+
+    from deequ_tpu import CheckStatus, Table, VerificationSuite
+    from deequ_tpu.analyzers import Size
+    from deequ_tpu.anomaly.strategies import RateOfChangeStrategy
+    from deequ_tpu.repository.base import ResultKey
+    from deequ_tpu.repository.memory import InMemoryMetricsRepository
+
+    repo = InMemoryMetricsRepository()
+    yesterday = Table.from_numpy({"x": np.arange(2.0)})
+    today = Table.from_numpy({"x": np.arange(5.0)})
+
+    r1 = (
+        VerificationSuite()
+        .on_data(yesterday)
+        .use_repository(repo)
+        .save_or_append_result(ResultKey(1000))
+        .add_anomaly_check(RateOfChangeStrategy(max_rate_increase=2.0), Size())
+        .run()
+    )
+    # first run: empty history -> the anomaly constraint fails like the
+    # reference's require(dataSeries.nonEmpty); only the SAVE matters here
+    assert repo.load_by_key(ResultKey(1000)).metric(Size()).value.get() == 2.0
+
+    r2 = (
+        VerificationSuite()
+        .on_data(today)
+        .use_repository(repo)
+        .save_or_append_result(ResultKey(2000))
+        .add_anomaly_check(RateOfChangeStrategy(max_rate_increase=2.0), Size())
+        .run()
+    )
+    assert r2.status == CheckStatus.WARNING  # 2 -> 5 is anomalous
+    # ... but the metric WAS saved after evaluation
+    assert repo.load_by_key(ResultKey(2000)).metric(Size()).value.get() == 5.0
