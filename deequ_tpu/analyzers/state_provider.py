@@ -85,98 +85,20 @@ class FileSystemStateProvider(StateLoader, StatePersister):
     def persist(self, analyzer: "Analyzer", state: State) -> None:
         from deequ_tpu.analyzers.frequency import FrequencyBasedAnalyzer
         from deequ_tpu.analyzers.histogram import Histogram
-        from deequ_tpu.analyzers.scan import (
-            Completeness,
-            Compliance,
-            Correlation,
-            DataType,
-            Maximum,
-            Mean,
-            Minimum,
-            PatternMatch,
-            Size,
-            StandardDeviation,
-            Sum,
-        )
-        from deequ_tpu.analyzers.sketch import ApproxCountDistinct, ApproxQuantile, ApproxQuantiles
 
         identifier = self._identifier(analyzer)
-
-        if isinstance(analyzer, Size):
-            self._write(identifier, struct.pack(">q", state.num_matches))
-        elif isinstance(analyzer, (Completeness, Compliance, PatternMatch)):
-            self._write(identifier, struct.pack(">qq", state.num_matches, state.count))
-        elif isinstance(analyzer, Sum):
-            self._write(identifier, struct.pack(">d", state.sum_value))
-        elif isinstance(analyzer, Mean):
-            self._write(identifier, struct.pack(">dq", state.total, state.count))
-        elif isinstance(analyzer, Minimum):
-            self._write(identifier, struct.pack(">d", state.min_value))
-        elif isinstance(analyzer, Maximum):
-            self._write(identifier, struct.pack(">d", state.max_value))
-        elif isinstance(analyzer, (FrequencyBasedAnalyzer, Histogram)):
+        if isinstance(analyzer, (FrequencyBasedAnalyzer, Histogram)):
+            # keep the reference's 3-file on-disk layout
+            # (parquet + numRows + columns)
             self._persist_frequencies(identifier, state)
-        elif isinstance(analyzer, DataType):
-            payload = struct.pack(
-                ">qqqqq",
-                state.num_null,
-                state.num_fractional,
-                state.num_integral,
-                state.num_boolean,
-                state.num_string,
-            )
-            self._write(identifier, struct.pack(">i", len(payload)) + payload)
-        elif isinstance(analyzer, ApproxCountDistinct):
-            words = state.words()
-            payload = struct.pack(f">{len(words)}q", *[int(w) for w in words])
-            self._write(identifier, struct.pack(">i", len(payload)) + payload)
-        elif isinstance(analyzer, Correlation):
-            self._write(
-                identifier,
-                struct.pack(
-                    ">dddddd",
-                    state.n,
-                    state.x_avg,
-                    state.y_avg,
-                    state.ck,
-                    state.x_mk,
-                    state.y_mk,
-                ),
-            )
-        elif isinstance(analyzer, StandardDeviation):
-            self._write(identifier, struct.pack(">ddd", state.n, state.avg, state.m2))
-        elif isinstance(analyzer, (ApproxQuantile, ApproxQuantiles)):
-            self._write(identifier, _serialize_kll(state.digest))
         else:
-            raise ValueError(f"Unable to persist state for analyzer {analyzer!r}.")
+            self._write(identifier, serialize_state(analyzer, state))
 
     # -- load ----------------------------------------------------------------
 
     def load(self, analyzer: "Analyzer") -> Optional[State]:
         from deequ_tpu.analyzers.frequency import FrequencyBasedAnalyzer
         from deequ_tpu.analyzers.histogram import Histogram
-        from deequ_tpu.analyzers.scan import (
-            Completeness,
-            Compliance,
-            Correlation,
-            DataType,
-            Maximum,
-            Mean,
-            Minimum,
-            PatternMatch,
-            Size,
-            StandardDeviation,
-            Sum,
-        )
-        from deequ_tpu.analyzers.sketch import (
-            ApproxCountDistinct,
-            ApproxCountDistinctState,
-            ApproxQuantile,
-            ApproxQuantiles,
-            ApproxQuantileState,
-        )
-        from deequ_tpu.analyzers import states as S
-        from deequ_tpu.ops.sketches import hll as hll_mod
 
         identifier = self._identifier(analyzer)
         if isinstance(analyzer, (FrequencyBasedAnalyzer, Histogram)):
@@ -184,38 +106,7 @@ class FileSystemStateProvider(StateLoader, StatePersister):
         data = self._read(identifier)
         if data is None:
             return None
-
-        if isinstance(analyzer, Size):
-            return S.NumMatches(struct.unpack(">q", data)[0])
-        if isinstance(analyzer, (Completeness, Compliance, PatternMatch)):
-            matches, count = struct.unpack(">qq", data)
-            return S.NumMatchesAndCount(matches, count)
-        if isinstance(analyzer, Sum):
-            return S.SumState(struct.unpack(">d", data)[0])
-        if isinstance(analyzer, Mean):
-            total, count = struct.unpack(">dq", data)
-            return S.MeanState(total, count)
-        if isinstance(analyzer, Minimum):
-            return S.MinState(struct.unpack(">d", data)[0])
-        if isinstance(analyzer, Maximum):
-            return S.MaxState(struct.unpack(">d", data)[0])
-        if isinstance(analyzer, DataType):
-            (length,) = struct.unpack(">i", data[:4])
-            values = struct.unpack(">qqqqq", data[4 : 4 + length])
-            return S.DataTypeHistogram(*values)
-        if isinstance(analyzer, ApproxCountDistinct):
-            (length,) = struct.unpack(">i", data[:4])
-            words = np.array(
-                struct.unpack(f">{length // 8}q", data[4 : 4 + length]), dtype=np.int64
-            )
-            return ApproxCountDistinctState(hll_mod.unpack_words(words))
-        if isinstance(analyzer, Correlation):
-            return S.CorrelationState(*struct.unpack(">dddddd", data))
-        if isinstance(analyzer, StandardDeviation):
-            return S.StandardDeviationState(*struct.unpack(">ddd", data))
-        if isinstance(analyzer, (ApproxQuantile, ApproxQuantiles)):
-            return ApproxQuantileState(_deserialize_kll(data))
-        raise ValueError(f"Unable to load state for analyzer {analyzer!r}.")
+        return deserialize_state(analyzer, data)
 
     # -- io ------------------------------------------------------------------
 
@@ -291,6 +182,180 @@ class FileSystemStateProvider(StateLoader, StatePersister):
             np.array(table.column(c).to_pylist(), dtype=object) for c in columns
         ]
         return FrequenciesAndNumRows(columns, key_columns, counts, int(num_rows))
+
+
+def serialize_state(analyzer: "Analyzer", state: State) -> bytes:
+    """State -> reference-layout bytes (per-type big-endian formats,
+    reference: StateProvider.scala:85-134). Frequency states get a
+    self-contained envelope (column names + numRows + in-memory Parquet)
+    so they can cross DCN, not just the filesystem."""
+    from deequ_tpu.analyzers.frequency import FrequencyBasedAnalyzer
+    from deequ_tpu.analyzers.histogram import Histogram
+    from deequ_tpu.analyzers.scan import (
+        Completeness,
+        Compliance,
+        Correlation,
+        DataType,
+        Maximum,
+        Mean,
+        Minimum,
+        PatternMatch,
+        Size,
+        StandardDeviation,
+        Sum,
+    )
+    from deequ_tpu.analyzers.sketch import ApproxCountDistinct, ApproxQuantile, ApproxQuantiles
+
+    if isinstance(analyzer, Size):
+        return struct.pack(">q", state.num_matches)
+    if isinstance(analyzer, (Completeness, Compliance, PatternMatch)):
+        return struct.pack(">qq", state.num_matches, state.count)
+    if isinstance(analyzer, Sum):
+        return struct.pack(">d", state.sum_value)
+    if isinstance(analyzer, Mean):
+        return struct.pack(">dq", state.total, state.count)
+    if isinstance(analyzer, Minimum):
+        return struct.pack(">d", state.min_value)
+    if isinstance(analyzer, Maximum):
+        return struct.pack(">d", state.max_value)
+    if isinstance(analyzer, (FrequencyBasedAnalyzer, Histogram)):
+        return _serialize_frequencies_bytes(state)
+    if isinstance(analyzer, DataType):
+        payload = struct.pack(
+            ">qqqqq",
+            state.num_null,
+            state.num_fractional,
+            state.num_integral,
+            state.num_boolean,
+            state.num_string,
+        )
+        return struct.pack(">i", len(payload)) + payload
+    if isinstance(analyzer, ApproxCountDistinct):
+        words = state.words()
+        payload = struct.pack(f">{len(words)}q", *[int(w) for w in words])
+        return struct.pack(">i", len(payload)) + payload
+    if isinstance(analyzer, Correlation):
+        return struct.pack(
+            ">dddddd",
+            state.n, state.x_avg, state.y_avg, state.ck, state.x_mk, state.y_mk,
+        )
+    if isinstance(analyzer, StandardDeviation):
+        return struct.pack(">ddd", state.n, state.avg, state.m2)
+    if isinstance(analyzer, (ApproxQuantile, ApproxQuantiles)):
+        return _serialize_kll(state.digest)
+    raise ValueError(f"Unable to persist state for analyzer {analyzer!r}.")
+
+
+def deserialize_state(analyzer: "Analyzer", data: bytes) -> State:
+    """Inverse of serialize_state (reference: StateProvider.scala:136-174)."""
+    from deequ_tpu.analyzers.frequency import FrequencyBasedAnalyzer
+    from deequ_tpu.analyzers.histogram import Histogram
+    from deequ_tpu.analyzers.scan import (
+        Completeness,
+        Compliance,
+        Correlation,
+        DataType,
+        Maximum,
+        Mean,
+        Minimum,
+        PatternMatch,
+        Size,
+        StandardDeviation,
+        Sum,
+    )
+    from deequ_tpu.analyzers.sketch import (
+        ApproxCountDistinct,
+        ApproxCountDistinctState,
+        ApproxQuantile,
+        ApproxQuantiles,
+        ApproxQuantileState,
+    )
+    from deequ_tpu.analyzers import states as S
+    from deequ_tpu.ops.sketches import hll as hll_mod
+
+    if isinstance(analyzer, Size):
+        return S.NumMatches(struct.unpack(">q", data)[0])
+    if isinstance(analyzer, (Completeness, Compliance, PatternMatch)):
+        matches, count = struct.unpack(">qq", data)
+        return S.NumMatchesAndCount(matches, count)
+    if isinstance(analyzer, Sum):
+        return S.SumState(struct.unpack(">d", data)[0])
+    if isinstance(analyzer, Mean):
+        total, count = struct.unpack(">dq", data)
+        return S.MeanState(total, count)
+    if isinstance(analyzer, Minimum):
+        return S.MinState(struct.unpack(">d", data)[0])
+    if isinstance(analyzer, Maximum):
+        return S.MaxState(struct.unpack(">d", data)[0])
+    if isinstance(analyzer, (FrequencyBasedAnalyzer, Histogram)):
+        return _deserialize_frequencies_bytes(data)
+    if isinstance(analyzer, DataType):
+        (length,) = struct.unpack(">i", data[:4])
+        values = struct.unpack(">qqqqq", data[4 : 4 + length])
+        return S.DataTypeHistogram(*values)
+    if isinstance(analyzer, ApproxCountDistinct):
+        (length,) = struct.unpack(">i", data[:4])
+        words = np.array(
+            struct.unpack(f">{length // 8}q", data[4 : 4 + length]), dtype=np.int64
+        )
+        return ApproxCountDistinctState(hll_mod.unpack_words(words))
+    if isinstance(analyzer, Correlation):
+        return S.CorrelationState(*struct.unpack(">dddddd", data))
+    if isinstance(analyzer, StandardDeviation):
+        return S.StandardDeviationState(*struct.unpack(">ddd", data))
+    if isinstance(analyzer, (ApproxQuantile, ApproxQuantiles)):
+        return ApproxQuantileState(_deserialize_kll(data))
+    raise ValueError(f"Unable to load state for analyzer {analyzer!r}.")
+
+
+def _serialize_frequencies_bytes(state) -> bytes:
+    """Envelope: ncols, utf8 names, numRows, in-memory Parquet payload."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from deequ_tpu.analyzers.base import COUNT_COL
+
+    columns = {
+        name: state.key_columns[i].tolist() for i, name in enumerate(state.columns)
+    }
+    columns[COUNT_COL] = [int(c) for c in state.counts]
+    sink = pa.BufferOutputStream()
+    pq.write_table(pa.table(columns), sink)
+    parquet = sink.getvalue().to_pybytes()
+
+    parts = [struct.pack(">i", len(state.columns))]
+    for name in state.columns:
+        encoded = name.encode("utf-8")
+        parts.append(struct.pack(">i", len(encoded)))
+        parts.append(encoded)
+    parts.append(struct.pack(">qi", state.num_rows, len(parquet)))
+    parts.append(parquet)
+    return b"".join(parts)
+
+
+def _deserialize_frequencies_bytes(data: bytes):
+    import pyarrow.parquet as pq
+    import pyarrow as pa
+
+    from deequ_tpu.analyzers.base import COUNT_COL
+    from deequ_tpu.analyzers.frequency import FrequenciesAndNumRows
+
+    (ncols,) = struct.unpack(">i", data[:4])
+    offset = 4
+    columns = []
+    for _ in range(ncols):
+        (length,) = struct.unpack(">i", data[offset : offset + 4])
+        offset += 4
+        columns.append(data[offset : offset + length].decode("utf-8"))
+        offset += length
+    num_rows, parquet_len = struct.unpack(">qi", data[offset : offset + 12])
+    offset += 12
+    table = pq.read_table(pa.BufferReader(data[offset : offset + parquet_len]))
+    counts = np.asarray(table.column(COUNT_COL).to_pylist(), dtype=np.int64)
+    key_columns = [
+        np.array(table.column(c).to_pylist(), dtype=object) for c in columns
+    ]
+    return FrequenciesAndNumRows(columns, key_columns, counts, int(num_rows))
 
 
 def _serialize_kll(digest) -> bytes:

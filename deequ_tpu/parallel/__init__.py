@@ -1,7 +1,13 @@
+from deequ_tpu.parallel import multihost
 from deequ_tpu.parallel.distributed import (
     DistributedScanPass,
     data_mesh,
     run_distributed_analysis,
 )
 
-__all__ = ["DistributedScanPass", "data_mesh", "run_distributed_analysis"]
+__all__ = [
+    "DistributedScanPass",
+    "data_mesh",
+    "multihost",
+    "run_distributed_analysis",
+]

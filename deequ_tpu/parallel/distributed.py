@@ -10,8 +10,9 @@ all_gather over the tiny state pytrees followed by a static fold of each
 analyzer's `merge_agg` — sums lower to psum-like collectives, min/max to
 pmin/pmax, HLL registers to an elementwise-max reduction, all riding ICI.
 
-Scales unchanged to multi-host: the mesh can span hosts (DCN) because only
-state pytrees (bytes to KB) cross device boundaries, never rows.
+Multi-host (DCN) is the second tier: parallel/multihost.py runs this pass
+per host on each host's partition and allgathers the serialized states —
+only state pytrees (bytes to KB) ever cross host boundaries, never rows.
 """
 
 from __future__ import annotations

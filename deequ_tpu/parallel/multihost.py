@@ -1,0 +1,192 @@
+"""Multi-host (DCN) execution: per-host analysis, state merge across hosts.
+
+The reference scales across machines by letting Spark shuffle partial
+aggregates between executors (reference: SURVEY.md §2.10, §5.8). The
+TPU-native shape of that is two-tier:
+
+  * WITHIN a host/slice: rows shard over the local mesh and states merge
+    in-graph with collectives over ICI (parallel/distributed.py).
+  * ACROSS hosts: each process analyzes ITS OWN partition of the data
+    (the partition it can read locally), produces per-analyzer States —
+    bytes to KB of sufficient statistics — and the states cross DCN via
+    `process_allgather`, serialized in the SAME binary layouts the
+    checkpoint layer uses (analyzers/state_provider.py,
+    reference: StateProvider.scala:85-174). Every host then folds the
+    semigroup (`State.sum`, reference: analyzers/Analyzer.scala:34-48)
+    and ends with identical table-level metrics.
+
+Only states ever cross host boundaries — never rows — so DCN bandwidth
+is irrelevant to scan throughput; this is the same property that makes
+`runOnAggregatedStates` (reference: AnalysisRunner.scala:375-446) scan-free.
+
+Usage on an N-host pod / CPU fleet:
+
+    from deequ_tpu.parallel import multihost
+    multihost.initialize(coordinator_address="host0:1234",
+                         num_processes=N, process_id=rank)
+    context = multihost.run_multihost_analysis(my_local_partition, analyzers)
+
+Single-process (jax.process_count() == 1) this degrades to a plain local
+run, so the same program runs unchanged from a laptop to a pod.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from deequ_tpu.analyzers.base import Analyzer
+from deequ_tpu.analyzers.state_provider import (
+    InMemoryStateProvider,
+    deserialize_state,
+    serialize_state,
+)
+from deequ_tpu.data.table import Table
+from deequ_tpu.runners.context import AnalyzerContext
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> None:
+    """Join the multi-process JAX runtime (jax.distributed.initialize).
+
+    On TPU pods the arguments are auto-detected from the environment; on
+    CPU/GPU fleets pass coordinator_address/num_processes/process_id."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+
+
+def global_data_mesh(axis_name: str = "data"):
+    """1-D mesh over ALL devices of ALL processes (ICI within a slice,
+    DCN between slices — XLA routes the collectives)."""
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()), (axis_name,))
+
+
+def allgather_bytes(payload: bytes) -> List[bytes]:
+    """Gather one variable-length byte string from every process.
+
+    Two collectives over DCN: fixed-size length exchange, then a
+    max-length padded uint8 gather. With one process this is the
+    identity — no device work at all."""
+    if jax.process_count() == 1:
+        return [payload]
+    from jax.experimental import multihost_utils
+
+    lengths = multihost_utils.process_allgather(
+        np.array([len(payload)], dtype=np.int32)
+    ).reshape(-1)
+    max_len = int(lengths.max())
+    buf = np.zeros(max(max_len, 1), dtype=np.uint8)
+    buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    gathered = multihost_utils.process_allgather(buf)
+    return [
+        gathered[i, : int(lengths[i])].tobytes() for i in range(jax.process_count())
+    ]
+
+
+# wire envelope tags: a host's contribution per analyzer
+_EMPTY = b"\x00"  # no state (all rows NULL in this partition)
+_STATE = b"\x01"  # serialized state follows
+_FAILED = b"\x02"  # analyzer failed on that host; utf-8 message follows
+
+
+def merge_states_across_hosts(
+    analyzers: Sequence[Analyzer],
+    local_states,
+    gather=allgather_bytes,
+    local_errors=None,
+) -> tuple:
+    """Allgather + semigroup-fold every analyzer's state across processes.
+
+    Returns (merged_states, errors): `errors` maps an analyzer to the
+    first failure message any host reported — a host-local failure must
+    fail the GLOBAL metric, not silently shrink it to the healthy hosts'
+    data. An analyzer whose local state is empty (all rows NULL in this
+    partition) contributes nothing, exactly like the reference's
+    optional-state merge (reference: Analyzer.scala:343-362).
+
+    `gather` is injectable so the merge law is testable without a real
+    multi-process runtime.
+    """
+    merged = InMemoryStateProvider()
+    errors = {}
+    local_errors = local_errors or {}
+    for analyzer in analyzers:
+        if analyzer in local_errors:
+            payload = _FAILED + str(local_errors[analyzer]).encode("utf-8")
+        else:
+            state = local_states.load(analyzer)
+            payload = (
+                _EMPTY if state is None else _STATE + serialize_state(analyzer, state)
+            )
+        for blob in gather(payload):
+            tag, body = blob[:1], blob[1:]
+            if tag == _FAILED and analyzer not in errors:
+                errors[analyzer] = body.decode("utf-8")
+            if tag != _STATE:
+                continue
+            other = deserialize_state(analyzer, body)
+            prev = merged.load(analyzer)
+            merged.persist(analyzer, other if prev is None else prev.merge(other))
+    return merged, errors
+
+
+def run_multihost_analysis(
+    local_table: Table,
+    analyzers: Sequence[Analyzer],
+    mesh=None,
+    engine: str = "auto",
+    gather=allgather_bytes,
+) -> AnalyzerContext:
+    """Analyze this process's partition locally, then merge states across
+    all processes; returns identical table-level metrics on every host
+    (the distributed form of runOnAggregatedStates,
+    reference: examples/UpdateMetricsOnPartitionedDataExample.scala:30-95).
+
+    A failure on ANY host fails that analyzer's global metric on EVERY
+    host — a partition that errored must not silently drop out of a
+    "successful" table-level number."""
+    from deequ_tpu.core.exceptions import MetricCalculationException
+    from deequ_tpu.runners.analysis_runner import AnalysisRunner
+
+    local_states = InMemoryStateProvider()
+    local_context = AnalysisRunner.do_analysis_run(
+        local_table,
+        analyzers,
+        save_states_with=local_states,
+        engine=engine,
+        mesh=mesh,
+    )
+    from deequ_tpu.core.exceptions import EmptyStateException
+
+    # an all-NULL local partition is a legitimately empty contribution
+    # (EmptyStateException), not a failure — other hosts may have data
+    local_errors = {
+        analyzer: metric.value.exception
+        for analyzer, metric in local_context.metric_map.items()
+        if metric.value.is_failure
+        and not isinstance(metric.value.exception, EmptyStateException)
+    }
+    merged, errors = merge_states_across_hosts(
+        analyzers, local_states, gather=gather, local_errors=local_errors
+    )
+    metrics = {}
+    for analyzer in analyzers:
+        if analyzer in errors:
+            metrics[analyzer] = analyzer.to_failure_metric(
+                MetricCalculationException(errors[analyzer])
+            )
+        else:
+            metrics[analyzer] = analyzer.compute_metric_from(merged.load(analyzer))
+    return AnalyzerContext(metrics)

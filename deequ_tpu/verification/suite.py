@@ -104,9 +104,16 @@ class VerificationSuite:
             state_loaders,
             save_states_with=save_states_with,
             metrics_repository=metrics_repository,
-            save_or_append_results_with_key=save_or_append_results_with_key,
+            # saved after evaluation, same as do_verification_run: anomaly
+            # assertions must not see this run's own metrics as history
+            save_or_append_results_with_key=None,
         )
-        return VerificationSuite.evaluate(checks, analysis_results)
+        verification_result = VerificationSuite.evaluate(checks, analysis_results)
+        if metrics_repository is not None and save_or_append_results_with_key is not None:
+            AnalysisRunner._save_or_append(
+                metrics_repository, save_or_append_results_with_key, analysis_results
+            )
+        return verification_result
 
     @staticmethod
     def is_check_applicable_to_data(check: Check, schema, num_records: int = 1000):

@@ -11,9 +11,7 @@ import pytest
 
 EXAMPLES_DIR = Path(__file__).resolve().parent.parent / "examples"
 
-EXAMPLES = sorted(
-    p.name for p in EXAMPLES_DIR.glob("*_example.py") if p.name != "example_utils.py"
-)
+EXAMPLES = sorted(p.name for p in EXAMPLES_DIR.glob("*_example.py"))
 
 
 def test_examples_inventory_matches_reference():
