@@ -147,11 +147,7 @@ class FileSystemStateProvider(StateLoader, StatePersister):
         directory = os.path.dirname(os.path.abspath(paths["-frequencies.pqt"])) or "."
         os.makedirs(directory, exist_ok=True)
 
-        columns = {
-            name: state.key_columns[i].tolist()
-            for i, name in enumerate(state.columns)
-        }
-        columns[COUNT_COL] = [int(c) for c in state.counts]
+        columns = _frequencies_to_columns(state)
         # write siblings first, parquet last via tmp+rename: load() keys on
         # the .pqt, so a crash mid-persist leaves a state that reads as
         # absent, never corrupt
@@ -177,11 +173,7 @@ class FileSystemStateProvider(StateLoader, StatePersister):
             columns = [line for line in f.read().split("\n") if line]
         with open(self._path(identifier, "-num_rows.bin"), "rb") as f:
             (num_rows,) = struct.unpack(">q", f.read())
-        counts = np.asarray(table.column(COUNT_COL).to_pylist(), dtype=np.int64)
-        key_columns = [
-            np.array(table.column(c).to_pylist(), dtype=object) for c in columns
-        ]
-        return FrequenciesAndNumRows(columns, key_columns, counts, int(num_rows))
+        return _frequencies_from_table(table, columns, num_rows)
 
 
 def serialize_state(analyzer: "Analyzer", state: State) -> bytes:
@@ -308,19 +300,37 @@ def deserialize_state(analyzer: "Analyzer", data: bytes) -> State:
     raise ValueError(f"Unable to load state for analyzer {analyzer!r}.")
 
 
-def _serialize_frequencies_bytes(state) -> bytes:
-    """Envelope: ncols, utf8 names, numRows, in-memory Parquet payload."""
-    import pyarrow as pa
-    import pyarrow.parquet as pq
-
+def _frequencies_to_columns(state) -> dict:
+    """State -> the {key columns..., COUNT_COL} dict both the on-disk
+    Parquet layout and the DCN envelope serialize."""
     from deequ_tpu.analyzers.base import COUNT_COL
 
     columns = {
         name: state.key_columns[i].tolist() for i, name in enumerate(state.columns)
     }
     columns[COUNT_COL] = [int(c) for c in state.counts]
+    return columns
+
+
+def _frequencies_from_table(table, columns, num_rows):
+    """Arrow table (+ declared key-column order, numRows) -> state."""
+    from deequ_tpu.analyzers.base import COUNT_COL
+    from deequ_tpu.analyzers.frequency import FrequenciesAndNumRows
+
+    counts = np.asarray(table.column(COUNT_COL).to_pylist(), dtype=np.int64)
+    key_columns = [
+        np.array(table.column(c).to_pylist(), dtype=object) for c in columns
+    ]
+    return FrequenciesAndNumRows(list(columns), key_columns, counts, int(num_rows))
+
+
+def _serialize_frequencies_bytes(state) -> bytes:
+    """Envelope: ncols, utf8 names, numRows, in-memory Parquet payload."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
     sink = pa.BufferOutputStream()
-    pq.write_table(pa.table(columns), sink)
+    pq.write_table(pa.table(_frequencies_to_columns(state)), sink)
     parquet = sink.getvalue().to_pybytes()
 
     parts = [struct.pack(">i", len(state.columns))]
@@ -337,9 +347,6 @@ def _deserialize_frequencies_bytes(data: bytes):
     import pyarrow.parquet as pq
     import pyarrow as pa
 
-    from deequ_tpu.analyzers.base import COUNT_COL
-    from deequ_tpu.analyzers.frequency import FrequenciesAndNumRows
-
     (ncols,) = struct.unpack(">i", data[:4])
     offset = 4
     columns = []
@@ -351,11 +358,7 @@ def _deserialize_frequencies_bytes(data: bytes):
     num_rows, parquet_len = struct.unpack(">qi", data[offset : offset + 12])
     offset += 12
     table = pq.read_table(pa.BufferReader(data[offset : offset + parquet_len]))
-    counts = np.asarray(table.column(COUNT_COL).to_pylist(), dtype=np.int64)
-    key_columns = [
-        np.array(table.column(c).to_pylist(), dtype=object) for c in columns
-    ]
-    return FrequenciesAndNumRows(columns, key_columns, counts, int(num_rows))
+    return _frequencies_from_table(table, columns, num_rows)
 
 
 def _serialize_kll(digest) -> bytes:

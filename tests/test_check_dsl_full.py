@@ -1,0 +1,402 @@
+"""Full-DSL check tests mirroring the reference's CheckTest.scala scenario
+by scenario (reference: src/test/scala/com/amazon/deequ/checks/CheckTest.scala)
+on the same fixture data (reference: utils/FixtureSupport.scala:86-188)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deequ_tpu import Check, CheckLevel, CheckStatus, Table, VerificationSuite
+from deequ_tpu.constraints.constraint import ConstraintStatus
+from deequ_tpu.runners.analysis_runner import AnalysisRunner
+
+
+def run_checks(table: Table, *checks: Check):
+    analyzers = []
+    for check in checks:
+        analyzers.extend(check.required_analyzers())
+    return AnalysisRunner.do_analysis_run(table, analyzers)
+
+
+def assert_evaluates_to(check: Check, context, status: CheckStatus):
+    assert check.evaluate(context).status == status, [
+        (r.constraint, r.message)
+        for r in check.evaluate(context).constraint_results
+    ]
+
+
+def df_complete_and_incomplete_columns() -> Table:
+    """reference: FixtureSupport.scala:86-97."""
+    return Table.from_numpy(
+        {
+            "item": np.array(["1", "2", "3", "4", "5", "6"], dtype=object),
+            "att1": np.array(["a", "b", "a", "a", "b", "a"], dtype=object),
+            "att2": np.array(["f", "d", None, "f", None, "f"], dtype=object),
+        }
+    )
+
+
+def df_with_unique_columns() -> Table:
+    """reference: FixtureSupport.scala:162-175."""
+    return Table.from_numpy(
+        {
+            "unique": np.array(["1", "2", "3", "4", "5", "6"], dtype=object),
+            "nonUnique": np.array(["0", "0", "0", "5", "6", "7"], dtype=object),
+            "nonUniqueWithNulls": np.array(
+                ["3", "3", "3", None, None, None], dtype=object
+            ),
+            "uniqueWithNulls": np.array(
+                ["1", "2", None, "3", "4", "5"], dtype=object
+            ),
+            "onlyUniqueWithOtherNonUnique": np.array(
+                ["5", "6", "7", "0", "0", "0"], dtype=object
+            ),
+            "halfUniqueCombinedWithNonUnique": np.array(
+                ["0", "0", "0", "4", "5", "6"], dtype=object
+            ),
+        }
+    )
+
+
+def df_with_distinct_values() -> Table:
+    """reference: FixtureSupport.scala:177-188."""
+    return Table.from_numpy(
+        {
+            "att1": np.array(["a", "a", None, "b", "b", "c"], dtype=object),
+            "att2": np.array([None, None, "x", "x", "x", "y"], dtype=object),
+        }
+    )
+
+
+def df_with_numeric_values() -> Table:
+    """reference: FixtureSupport.scala:137-148 — att2 always > att1 for
+    the last three rows only."""
+    return Table.from_numpy(
+        {
+            "item": np.array(["1", "2", "3", "4", "5", "6"], dtype=object),
+            "att1": np.array([1, 2, 3, 4, 5, 6], dtype=np.int64),
+            "att2": np.array([0, 0, 0, 5, 6, 7], dtype=np.int64),
+        }
+    )
+
+
+class TestCheckStatuses:
+    """reference: CheckTest.scala:42-62."""
+
+    def test_completeness(self):
+        check1 = (
+            Check(CheckLevel.ERROR, "group-1")
+            .is_complete("att1")
+            .has_completeness("att1", lambda v: v == 1.0)
+        )
+        check2 = Check(CheckLevel.ERROR, "group-2-E").has_completeness(
+            "att2", lambda v: v > 0.8
+        )
+        check3 = Check(CheckLevel.WARNING, "group-2-W").has_completeness(
+            "att2", lambda v: v > 0.8
+        )
+        context = run_checks(df_complete_and_incomplete_columns(), check1, check2, check3)
+        assert_evaluates_to(check1, context, CheckStatus.SUCCESS)
+        assert_evaluates_to(check2, context, CheckStatus.ERROR)
+        assert_evaluates_to(check3, context, CheckStatus.WARNING)
+
+    def test_uniqueness(self):
+        """reference: CheckTest.scala:64-81."""
+        check = (
+            Check(CheckLevel.ERROR, "group-1")
+            .is_unique("unique")
+            .is_unique("uniqueWithNulls")
+            .is_unique("nonUnique")
+            .is_unique("nonUniqueWithNulls")
+        )
+        context = run_checks(df_with_unique_columns(), check)
+        result = check.evaluate(context)
+        assert result.status == CheckStatus.ERROR
+        statuses = [r.status for r in result.constraint_results]
+        assert statuses == [
+            ConstraintStatus.SUCCESS,
+            ConstraintStatus.FAILURE,
+            ConstraintStatus.FAILURE,
+            ConstraintStatus.FAILURE,
+        ]
+
+    def test_distinctness(self):
+        """reference: CheckTest.scala:83-98."""
+        check = (
+            Check(CheckLevel.ERROR, "distinctness-check")
+            .has_distinctness(["att1"], lambda v: v == 0.5)
+            .has_distinctness(["att1", "att2"], lambda v: v == 1.0 / 3)
+            .has_distinctness(["att2"], lambda v: v == 1.0)
+        )
+        context = run_checks(df_with_distinct_values(), check)
+        result = check.evaluate(context)
+        assert result.status == CheckStatus.ERROR
+        statuses = [r.status for r in result.constraint_results]
+        assert statuses == [
+            ConstraintStatus.SUCCESS,
+            ConstraintStatus.SUCCESS,
+            ConstraintStatus.FAILURE,
+        ]
+
+    def test_has_uniqueness_overloads(self):
+        """reference: CheckTest.scala:100-126."""
+        check = (
+            Check(CheckLevel.ERROR, "group-1-u")
+            .has_uniqueness(["nonUnique"], lambda fraction: fraction == 0.5)
+            .has_uniqueness(["nonUnique"], lambda fraction: fraction < 0.6)
+            .has_uniqueness(
+                ["halfUniqueCombinedWithNonUnique", "nonUnique"],
+                lambda fraction: fraction == 0.5,
+            )
+            .has_uniqueness(
+                ["onlyUniqueWithOtherNonUnique", "nonUnique"], Check.IsOne
+            )
+            .has_uniqueness(["unique"], Check.IsOne)
+            .has_uniqueness(["uniqueWithNulls"], Check.IsOne)
+        )
+        context = run_checks(df_with_unique_columns(), check)
+        result = check.evaluate(context)
+        assert result.status == CheckStatus.ERROR
+        statuses = [r.status for r in result.constraint_results]
+        assert statuses == [
+            ConstraintStatus.SUCCESS,
+            ConstraintStatus.SUCCESS,
+            ConstraintStatus.SUCCESS,
+            ConstraintStatus.SUCCESS,
+            ConstraintStatus.SUCCESS,
+            ConstraintStatus.FAILURE,  # nulls are duplicated
+        ]
+
+    def test_conditional_column_constraints(self):
+        """reference: CheckTest.scala:174-192."""
+        check_to_succeed = (
+            Check(CheckLevel.ERROR, "group-1")
+            .satisfies("att1 < att2", "rule1")
+            .where("att1 > 3")
+        )
+        check_to_fail = (
+            Check(CheckLevel.ERROR, "group-1")
+            .satisfies("att2 > 0", "rule2")
+            .where("att1 > 0")
+        )
+        check_partial = (
+            Check(CheckLevel.ERROR, "group-1")
+            .satisfies("att2 > 0", "rule3", lambda v: v == 0.5)
+            .where("att1 > 0")
+        )
+        context = run_checks(
+            df_with_numeric_values(), check_to_succeed, check_to_fail, check_partial
+        )
+        assert_evaluates_to(check_to_succeed, context, CheckStatus.SUCCESS)
+        assert_evaluates_to(check_to_fail, context, CheckStatus.ERROR)
+        assert_evaluates_to(check_partial, context, CheckStatus.SUCCESS)
+
+    def test_convenience_constraints(self):
+        """reference: CheckTest.scala:194-239."""
+        less_than = (
+            Check(CheckLevel.ERROR, "a").is_less_than("att1", "att2").where("item > 3")
+        )
+        incorrect_less_than = Check(CheckLevel.ERROR, "a").is_less_than("att1", "att2")
+        non_negative = Check(CheckLevel.ERROR, "a").is_non_negative("item")
+        positive = Check(CheckLevel.ERROR, "a").is_positive("item")
+        context = run_checks(
+            df_with_numeric_values(),
+            less_than, incorrect_less_than, non_negative, positive,
+        )
+        assert_evaluates_to(less_than, context, CheckStatus.SUCCESS)
+        assert_evaluates_to(incorrect_less_than, context, CheckStatus.ERROR)
+        assert_evaluates_to(non_negative, context, CheckStatus.SUCCESS)
+        assert_evaluates_to(positive, context, CheckStatus.SUCCESS)
+
+    def test_is_contained_in_values(self):
+        """reference: CheckTest.scala:236-254."""
+        range_check = Check(CheckLevel.ERROR, "a").is_contained_in(
+            "att1", ["a", "b", "c"]
+        )
+        incorrect = Check(CheckLevel.ERROR, "a").is_contained_in("att1", ["a", "b"])
+        custom = Check(CheckLevel.ERROR, "a").is_contained_in(
+            "att1", ["a"], lambda v: v == 0.5
+        )
+        context = run_checks(df_with_distinct_values(), range_check, incorrect, custom)
+        assert_evaluates_to(range_check, context, CheckStatus.SUCCESS)
+        assert_evaluates_to(incorrect, context, CheckStatus.ERROR)
+        # 2 of 6 values are 'a', 1 is NULL (counts as pass), 3 fail -> 0.5
+        assert_evaluates_to(custom, context, CheckStatus.SUCCESS)
+
+    @pytest.mark.parametrize(
+        "lower,upper,inc_lower,inc_upper,expected",
+        [
+            (0, 7, True, True, CheckStatus.SUCCESS),   # nr1
+            (1, 7, True, True, CheckStatus.ERROR),     # nr2
+            (0, 6, True, True, CheckStatus.ERROR),     # nr3
+            (0, 7, False, False, CheckStatus.ERROR),   # nr4
+            (-1, 8, False, False, CheckStatus.SUCCESS),  # nr5
+            (0, 7, True, False, CheckStatus.ERROR),    # nr6
+            (0, 8, True, False, CheckStatus.SUCCESS),  # nr7
+            (0, 7, False, True, CheckStatus.ERROR),    # nr8
+            (-1, 7, False, True, CheckStatus.SUCCESS),  # nr9
+        ],
+    )
+    def test_is_contained_in_bounds(self, lower, upper, inc_lower, inc_upper, expected):
+        """reference: CheckTest.scala:256-273 — all 9 bound combinations."""
+        check = Check(CheckLevel.ERROR, "nr").is_contained_in(
+            "att2",
+            lower_bound=lower,
+            upper_bound=upper,
+            include_lower_bound=inc_lower,
+            include_upper_bound=inc_upper,
+        )
+        context = run_checks(df_with_numeric_values(), check)
+        assert_evaluates_to(check, context, expected)
+
+
+class TestEmbeddedPatterns:
+    """containsX finds patterns EMBEDDED in text, not anchored
+    (reference: CheckTest.scala:439-476)."""
+
+    def _single_column(self, value: str) -> Table:
+        return Table.from_numpy({"some": np.array([value], dtype=object)})
+
+    def test_credit_card_embedded(self):
+        table = self._single_column("My credit card number is: 4111-1111-1111-1111.")
+        check = Check(CheckLevel.ERROR, "d").contains_credit_card_number(
+            "some", lambda v: v == 1.0
+        )
+        assert_evaluates_to(check, run_checks(table, check), CheckStatus.SUCCESS)
+
+    def test_email_embedded(self):
+        table = self._single_column("Please contact me at someone@somewhere.org, thank you.")
+        check = Check(CheckLevel.ERROR, "d").contains_email("some", lambda v: v == 1.0)
+        assert_evaluates_to(check, run_checks(table, check), CheckStatus.SUCCESS)
+
+    def test_url_embedded(self):
+        table = self._single_column(
+            "Hey, please have a look at https://www.example.com/foo/?bar=baz&inga=42&quux!"
+        )
+        check = Check(CheckLevel.ERROR, "d").contains_url("some", lambda v: v == 1.0)
+        assert_evaluates_to(check, run_checks(table, check), CheckStatus.SUCCESS)
+
+    def test_ssn_embedded(self):
+        table = self._single_column("My SSN is 111-05-1130, thanks.")
+        check = Check(CheckLevel.ERROR, "d").contains_social_security_number(
+            "some", lambda v: v == 1.0
+        )
+        assert_evaluates_to(check, run_checks(table, check), CheckStatus.SUCCESS)
+
+    def test_mixed_data_fails_default_assertion(self):
+        """reference: CheckTest.scala:362-370, 381-389 — default assertion
+        is IsOne; mixed data fails it."""
+        table = Table.from_numpy(
+            {
+                "some": np.array(
+                    ["someone@somewhere.org", "someone@else"], dtype=object
+                )
+            }
+        )
+        check = Check(CheckLevel.ERROR, "d").contains_email("some")
+        assert_evaluates_to(check, run_checks(table, check), CheckStatus.ERROR)
+
+
+class TestExoticColumnNames:
+    """Backtick-quoted SQL generation must survive special characters
+    (reference: CheckTest.scala:491-558)."""
+
+    COLUMN = "att.1 with space"
+
+    def test_is_contained_in_values_variant(self):
+        table = Table.from_numpy(
+            {self.COLUMN: np.array(["a", "b", "a"], dtype=object)}
+        )
+        check = Check(CheckLevel.ERROR, "c").is_contained_in(self.COLUMN, ["a", "b"])
+        result = VerificationSuite().on_data(table).add_check(check).run()
+        assert result.status == CheckStatus.SUCCESS
+
+    def test_is_contained_in_bounds_variant(self):
+        table = Table.from_numpy({self.COLUMN: np.array([1.0, 2.0, 3.0])})
+        check = Check(CheckLevel.ERROR, "c").is_contained_in(
+            self.COLUMN, lower_bound=0.0, upper_bound=4.0
+        )
+        result = VerificationSuite().on_data(table).add_check(check).run()
+        assert result.status == CheckStatus.SUCCESS
+
+
+class TestAnomalyHistoryFiltering:
+    """reference: CheckTest.scala:647-714 — only history inside the
+    configured window / tags feeds the detector."""
+
+    def _repo_with_history(self):
+        from deequ_tpu.analyzers import Size
+        from deequ_tpu.core.maybe import Success
+        from deequ_tpu.core.metrics import DoubleMetric, Entity
+        from deequ_tpu.repository.base import ResultKey
+        from deequ_tpu.repository.memory import InMemoryMetricsRepository
+        from deequ_tpu.runners.context import AnalyzerContext
+
+        repo = InMemoryMetricsRepository()
+        for ts, value, tags in [
+            (1000, 11.0, {"env": "prod"}),
+            (2000, 12.0, {"env": "prod"}),
+            (3000, 50.0, {"env": "test"}),  # outlier under a different tag
+        ]:
+            repo.save(
+                ResultKey(ts, tags),
+                AnalyzerContext(
+                    {
+                        Size(): DoubleMetric(
+                            Entity.DATASET, "Size", "*", Success(value)
+                        )
+                    }
+                ),
+            )
+        return repo
+
+    def test_tag_filter_excludes_other_environments(self):
+        from deequ_tpu.analyzers import Size
+        from deequ_tpu.anomaly.strategies import SimpleThresholdStrategy
+
+        repo = self._repo_with_history()
+        table = Table.from_numpy({"x": np.arange(13.0)})  # size 13
+        # with the prod tag filter, history is [11, 12] and 13 is fine;
+        # without it, the test outlier (50) would not change simple
+        # threshold semantics, so use a rate bound instead
+        check = Check(CheckLevel.WARNING, "anomaly").is_newest_point_non_anomalous(
+            repo,
+            SimpleThresholdStrategy(lower_bound=0.0, upper_bound=20.0),
+            Size(),
+            {"env": "prod"},
+            None,
+            None,
+        )
+        context = run_checks(table, check)
+        assert check.evaluate(context).status == CheckStatus.SUCCESS
+
+    def test_before_after_window(self):
+        from deequ_tpu.analyzers import Size
+        from deequ_tpu.anomaly.strategies import RateOfChangeStrategy
+
+        repo = self._repo_with_history()
+        table = Table.from_numpy({"x": np.arange(13.0)})  # size 13
+        # window [0, 2500]: history [11, 12] -> 13 is a +1 step: fine
+        ok = Check(CheckLevel.WARNING, "anomaly").is_newest_point_non_anomalous(
+            repo,
+            RateOfChangeStrategy(max_rate_increase=2.0),
+            Size(),
+            None,
+            0,
+            2500,
+        )
+        context = run_checks(table, ok)
+        assert ok.evaluate(context).status == CheckStatus.SUCCESS
+        # full window: the tagged outlier 50 enters history -> 50 -> 13
+        # is a huge negative step; with a decrease bound it is anomalous
+        bad = Check(CheckLevel.WARNING, "anomaly").is_newest_point_non_anomalous(
+            repo,
+            RateOfChangeStrategy(max_rate_decrease=-5.0, max_rate_increase=40.0),
+            Size(),
+            None,
+            None,
+            None,
+        )
+        context = run_checks(table, bad)
+        assert bad.evaluate(context).status == CheckStatus.WARNING

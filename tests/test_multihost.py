@@ -116,24 +116,27 @@ def test_multihost_merge_equals_whole_table():
         AnalysisRunner.do_analysis_run(part, ALL_ANALYZERS, save_states_with=provider)
         local_providers.append(provider)
 
+    import struct
+
+    def envelope(blobs):
+        return b"".join(struct.pack(">i", len(b)) + b for b in blobs)
+
     def fake_gather_for(host_idx):
         def gather(payload: bytes):
             # every host contributes its serialized state for the SAME
-            # analyzer being merged; recover which analyzer from payload
-            # by position: merge_states_across_hosts serializes exactly
-            # the host's own state, so reproduce the other hosts' blobs
-            # via the same analyzer currently in flight
+            # analyzer being merged (one-analyzer envelope per call here)
             analyzer = gather.current_analyzer
-            blobs = []
+            envelopes = []
             for provider in local_providers:
                 state = provider.load(analyzer)
-                blobs.append(
+                blob = (
                     b"\x00"
                     if state is None
                     else b"\x01" + serialize_state(analyzer, state)
                 )
-            assert blobs[host_idx] == payload
-            return blobs
+                envelopes.append(envelope([blob]))
+            assert envelopes[host_idx] == payload
+            return envelopes
 
         return gather
 
@@ -187,8 +190,13 @@ def test_host_failure_fails_global_metric():
     not silently shrink it to the healthy hosts' data."""
     table = make_table(4)
 
+    import struct
+
     def gather_with_remote_failure(payload: bytes):
-        return [payload, b"\x02" + b"boom on host 1"]
+        # host 1 reports a failure for BOTH analyzers in the envelope
+        blob = b"\x02" + b"boom on host 1"
+        failing = b"".join([struct.pack(">i", len(blob)) + blob] * 2)
+        return [payload, failing]
 
     ctx = multihost.run_multihost_analysis(
         table, [Size(), Mean("x")], gather=gather_with_remote_failure
@@ -212,17 +220,25 @@ def test_local_failure_propagates_but_empty_partition_does_not():
 
     all_null = T.from_numpy({"x": np.full(10, np.nan)})
 
+    import struct
+
     def gather_with_data_elsewhere(payload: bytes):
         other = InMemoryStateProvider()
         AnalysisRunner.do_analysis_run(
             make_table(6), [Mean("x")], save_states_with=other
         )
-        return [
-            payload,
-            b"\x01" + serialize_state(Mean("x"), other.load(Mean("x"))),
-        ]
+        blob = b"\x01" + serialize_state(Mean("x"), other.load(Mean("x")))
+        return [payload, struct.pack(">i", len(blob)) + blob]
 
     ctx2 = multihost.run_multihost_analysis(
         all_null, [Mean("x")], gather=gather_with_data_elsewhere
     )
     assert ctx2.metric_map[Mean("x")].value.is_success
+
+
+def test_duplicate_analyzers_merge_once():
+    """Repeated analyzers (e.g. two checks requiring Size()) must not
+    double-count the global metric."""
+    table = make_table(8, n=100)
+    ctx = multihost.run_multihost_analysis(table, [Size(), Size(), Mean("x")])
+    assert ctx.metric_map[Size()].value.get() == 100.0
