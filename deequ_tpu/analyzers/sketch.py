@@ -82,11 +82,8 @@ def _hll_spec(column: str) -> InputSpec:
             sel = codes >= 0
             packed[sel] = ((idx_u << 6) | rank_u)[codes[sel]]
             return packed
-        hashes = hll.hash_column(col.values, col.valid)
-        idx_v, rank_v = hll.registers_from_hashes(hashes)
-        packed = np.zeros(len(col), dtype=np.int32)
-        packed[col.valid] = (idx_v << 6) | rank_v
-        return packed
+        # one-pass C kernel when available, identical numpy codes otherwise
+        return hll.pack_codes(col.values, col.valid)
 
     return InputSpec(key=f"hll:{column}", build=build)
 
@@ -116,13 +113,21 @@ class ApproxCountDistinct(ScanShareableAnalyzer):
     def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
         packed = xp.asarray(inputs[f"hll:{self.column}"])
         w = inputs[where_key(self.where)]
+        if xp is np:
+            from deequ_tpu.ops import native
+
+            registers = np.zeros(hll.M, dtype=np.int32)
+            where = np.asarray(w)
+            if native.hll_update_registers(
+                np.asarray(packed), None if where.all() else where, registers
+            ):
+                return {"registers": registers}
+            masked_rank = np.where(where, packed & 0x3F, 0)
+            np.maximum.at(registers, np.asarray(packed >> 6), masked_rank)
+            return {"registers": registers}
         idx = packed >> 6
         rank = packed & 0x3F
         masked_rank = xp.where(xp.asarray(w), rank, 0)
-        if xp is np:
-            registers = np.zeros(hll.M, dtype=np.int32)
-            np.maximum.at(registers, np.asarray(idx), masked_rank)
-            return {"registers": registers}
         registers = xp.zeros(hll.M, dtype=masked_rank.dtype).at[idx].max(masked_rank)
         return {"registers": registers}
 

@@ -1,0 +1,153 @@
+"""Native host kernels, compiled on demand and loaded via ctypes.
+
+Where the reference drops below Spark's public API into JVM Catalyst
+kernels for its hot aggregation loops (reference: analyzers/catalyst/,
+SURVEY.md §2.6), this package drops below numpy into C for the host-side
+hot loops that are not single vectorized reductions — currently the
+xxhash64+HLL pack stage. The build is a single `cc -O3 -shared` at first
+use, cached beside the package; every entry point degrades gracefully to
+the vectorized numpy implementation when no compiler is available, so the
+framework never REQUIRES the native path (same spirit as the reference
+running with codegen disabled).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_SOURCE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "xxhash_hll.c")
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _cache_dirs():
+    """Candidate build dirs: the package itself, then a PER-USER 0700
+    cache — never a shared world-writable path, so no other user can
+    plant a library where we would dlopen it."""
+    yield os.path.dirname(_SOURCE)
+    try:
+        uid = os.getuid()
+    except AttributeError:  # non-posix
+        uid = "u"
+    user_dir = os.path.join(tempfile.gettempdir(), f"deequ_tpu_native_{uid}")
+    try:
+        os.makedirs(user_dir, mode=0o700, exist_ok=True)
+        if os.stat(user_dir).st_uid == os.getuid():
+            yield user_dir
+    except OSError:
+        pass
+
+
+def _build_library() -> Optional[str]:
+    """Compile the kernel; atomic tmp+rename so concurrent processes
+    (the normal multihost case) never observe a half-written library."""
+    src_mtime = os.path.getmtime(_SOURCE)
+    for directory in _cache_dirs():
+        out = os.path.join(directory, "_deequ_native.so")
+        if os.path.exists(out) and os.path.getmtime(out) >= src_mtime:
+            return out
+        for compiler in ("cc", "gcc", "clang"):
+            tmp = None
+            try:
+                fd, tmp = tempfile.mkstemp(suffix=".so", dir=directory)
+                os.close(fd)
+                subprocess.run(
+                    [compiler, "-O3", "-shared", "-fPIC", _SOURCE, "-o", tmp],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+                os.replace(tmp, out)
+                return out
+            except (OSError, subprocess.SubprocessError):
+                if tmp is not None and os.path.exists(tmp):
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+                continue
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("DEEQU_TPU_NO_NATIVE"):
+        return None
+    path = _build_library()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.xxhash64_pack.argtypes = [
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.xxhash64_pack.restype = None
+        lib.hll_update_registers.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.hll_update_registers.restype = None
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def xxhash64_pack(values: np.ndarray, valid: np.ndarray) -> Optional[np.ndarray]:
+    """(idx << 6 | rank) int32 per row from canonical int64 values; None
+    when the native library is unavailable (caller falls back to numpy)."""
+    lib = _load()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, dtype=np.int64)
+    valid_u8 = np.ascontiguousarray(valid, dtype=np.uint8)
+    packed = np.empty(len(values), dtype=np.int32)
+    lib.xxhash64_pack(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        valid_u8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        len(values),
+        packed.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return packed
+
+
+def hll_update_registers(
+    packed: np.ndarray, where: Optional[np.ndarray], registers: np.ndarray
+) -> bool:
+    """In-place register scatter-max; False when native is unavailable."""
+    lib = _load()
+    if lib is None:
+        return False
+    packed = np.ascontiguousarray(packed, dtype=np.int32)
+    where_ptr = (
+        np.ascontiguousarray(where, dtype=np.uint8).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_uint8)
+        )
+        if where is not None
+        else None
+    )
+    lib.hll_update_registers(
+        packed.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        where_ptr,
+        len(packed),
+        registers.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return True

@@ -1,0 +1,79 @@
+/* Native host kernels for the scan hot path.
+ *
+ * The placement engine folds discrete analyzers on the host when the
+ * device link is slow (ops/runtime.py:placement_mode); the one host stage
+ * that is not a single vectorized numpy reduction is HLL hashing: xxhash64
+ * per row plus register index/rank extraction. numpy needs ~15 passes over
+ * the buffer for that; this C loop does it in one pass at memory speed.
+ *
+ * Same semantics as the vectorized numpy path (ops/sketches/hll.py):
+ * xxhash64 of the 8-byte value with seed 42, idx = top P bits, rank =
+ * 1 + leading zeros of the remainder (capped for a 6-bit register) —
+ * the same parameters as the reference kernel
+ * (reference: catalyst/StatefulHyperloglogPlus.scala:86-155, p=9 from
+ * RELATIVE_SD=0.05, 512 registers).
+ */
+
+#include <stdint.h>
+#include <stddef.h>
+
+#define P 9
+#define SEED 42ULL
+
+static const uint64_t PRIME1 = 0x9E3779B185EBCA87ULL;
+static const uint64_t PRIME2 = 0xC2B2AE3D27D4EB4FULL;
+static const uint64_t PRIME3 = 0x165667B19E3779F9ULL;
+static const uint64_t PRIME4 = 0x85EBCA77C2B2AE63ULL;
+static const uint64_t PRIME5 = 0x27D4EB2F165667C5ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+    return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t xxhash64_u64(uint64_t v) {
+    uint64_t acc = v * PRIME2;
+    acc = rotl64(acc, 31);
+    acc *= PRIME1;
+    acc ^= SEED + PRIME5 + 8ULL;
+    acc = rotl64(acc, 27);
+    acc *= PRIME1;
+    acc += PRIME4;
+    acc ^= acc >> 33;
+    acc *= PRIME2;
+    acc ^= acc >> 29;
+    acc *= PRIME3;
+    acc ^= acc >> 32;
+    return acc;
+}
+
+/* packed[i] = (register_idx << 6) | rank for valid rows, 0 otherwise.
+ * values: canonical 8-byte representation per row (int64 buffer). */
+void xxhash64_pack(const int64_t *values, const uint8_t *valid, int64_t n,
+                   int32_t *packed) {
+    const int max_rank = 64 - P + 1;
+    for (int64_t i = 0; i < n; i++) {
+        if (!valid[i]) {
+            packed[i] = 0;
+            continue;
+        }
+        uint64_t h = xxhash64_u64((uint64_t)values[i]);
+        int32_t idx = (int32_t)(h >> (64 - P));
+        uint64_t rest = (h << P) | (1ULL << (P - 1));
+        int rank = 1 + __builtin_clzll(rest);
+        if (rank > max_rank) rank = max_rank;
+        packed[i] = (idx << 6) | rank;
+    }
+}
+
+/* register scatter-max over packed codes (the host fold of the HLL
+ * reduce): regs must hold 1 << P int32 slots. where==NULL means all rows. */
+void hll_update_registers(const int32_t *packed, const uint8_t *where,
+                          int64_t n, int32_t *regs) {
+    for (int64_t i = 0; i < n; i++) {
+        if (where && !where[i]) continue;
+        int32_t code = packed[i];
+        int32_t idx = code >> 6;
+        int32_t rank = code & 0x3F;
+        if (rank > regs[idx]) regs[idx] = rank;
+    }
+}

@@ -73,40 +73,51 @@ def xxhash64_u64(values: np.ndarray, seed: np.uint64 = SEED) -> np.ndarray:
         return acc
 
 
-def hash_column(values: np.ndarray, valid: np.ndarray) -> np.ndarray:
-    """64-bit hashes for the valid rows of a column (any dtype)."""
-    if values.dtype == object or values.dtype.kind == "U":
-        # strings: hash unique values only (vectorized), gather to rows
-        from deequ_tpu.ops.strings import hash_strings
-
-        uniques, inv = np.unique(values[valid].astype(str), return_inverse=True)
-        return hash_strings(uniques)[inv]
+def canonical_int64(values: np.ndarray) -> np.ndarray:
+    """Canonical 8-byte form whose xxhash64 defines a value's identity:
+    floats by their float64 bit pattern, timestamps as epoch-us, ints and
+    bools as int64 (reference: the Catalyst kernel hashes the raw 8-byte
+    value the same way, StatefulHyperloglogPlus.scala:86-115)."""
     if values.dtype == np.bool_:
-        values = values.astype(np.int64)
+        return values.astype(np.int64)
     if np.issubdtype(values.dtype, np.floating):
-        values = values.astype(np.float64).view(np.int64)
-    elif np.issubdtype(values.dtype, np.datetime64):
-        values = values.astype("datetime64[us]").astype(np.int64)
-    else:
-        values = values.astype(np.int64)
-    return xxhash64_u64(values[valid])
+        return values.astype(np.float64).view(np.int64)
+    if np.issubdtype(values.dtype, np.datetime64):
+        return values.astype("datetime64[us]").astype(np.int64)
+    return values.astype(np.int64, copy=False)
+
+
+def pack_codes(values: np.ndarray, valid: np.ndarray) -> np.ndarray:
+    """(register idx << 6 | rank) int32 per row; 0 for invalid rows.
+
+    The one-pass C kernel (ops/native) does hash+clz+pack at memory
+    speed; the numpy fallback computes the identical codes in ~15
+    vectorized passes."""
+    from deequ_tpu.ops import native
+
+    canon = canonical_int64(values)
+    packed = native.xxhash64_pack(canon, valid)
+    if packed is not None:
+        return packed
+    idx, rank = registers_from_hashes(xxhash64_u64(canon[valid]))
+    packed = np.zeros(len(values), dtype=np.int32)
+    packed[valid] = (idx << 6) | rank
+    return packed
 
 
 def registers_from_hashes(hashes: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """(register index, rank) per hash: idx = top P bits, rank = 1 +
     leading zeros of the remaining bits (capped for the 6-bit register).
 
-    CLZ is vectorized via the f32 exponent of the top 32 bits (3 cheap
-    in-place ops instead of a f64 frexp): rank = 32 - floor(log2(top)).
-    The f32 mantissa rounds values just below a power of two upward with
-    probability ~2^-24 per value, making that rank 1 too small — far
-    below the sketch's rsd=0.05 noise floor. top==0 (probability 2^-32
-    per value) falls back to an exact scalar loop."""
+    CLZ is vectorized EXACTLY via the f64 exponent of the top 32 bits
+    (uint32 -> f64 is lossless, so floor(log2(top)) is the true
+    exponent); this matches the C kernel's __builtin_clzll bit for bit.
+    top==0 (probability 2^-32 per value) falls back to a scalar loop."""
     idx = (hashes >> np.uint64(64 - P)).astype(np.int32)
     rest = (hashes << np.uint64(P)) | (np.uint64(1) << np.uint64(P - 1))
     top = (rest >> np.uint64(32)).astype(np.uint32)
-    f_bits = top.astype(np.float32).view(np.uint32)
-    exponent = (f_bits >> np.uint32(23)).astype(np.int32) - 127
+    f_bits = top.astype(np.float64).view(np.uint64)
+    exponent = (f_bits >> np.uint64(52)).astype(np.int32) - 1023
     rank = 32 - exponent
     zero_top = top == 0
     if zero_top.any():

@@ -1,0 +1,75 @@
+"""Native C kernels (ops/native): bit-exact parity with the vectorized
+numpy implementations, graceful fallback, and in-place register update."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deequ_tpu.ops import native
+from deequ_tpu.ops.sketches import hll
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(99)
+
+
+def _reference_pack(canon: np.ndarray, valid: np.ndarray) -> np.ndarray:
+    idx, rank = hll.registers_from_hashes(hll.xxhash64_u64(canon[valid]))
+    packed = np.zeros(len(canon), dtype=np.int32)
+    packed[valid] = (idx << 6) | rank
+    return packed
+
+
+@pytest.mark.skipif(not native.available(), reason="no C compiler")
+class TestNativeParity:
+    @pytest.mark.parametrize(
+        "values",
+        [
+            lambda r: r.normal(size=50_000),
+            lambda r: r.integers(-(2**60), 2**60, 50_000),
+            lambda r: r.integers(0, 2, 50_000).astype(bool),
+            lambda r: np.array(
+                [0.0, -0.0, np.inf, -np.inf, 5e-324, 2.0**31, np.pi]
+            ),
+        ],
+    )
+    def test_pack_matches_numpy(self, values, rng):
+        vals = values(rng)
+        valid = rng.random(len(vals)) > 0.15
+        canon = hll.canonical_int64(np.asarray(vals))
+        assert np.array_equal(
+            native.xxhash64_pack(canon, valid), _reference_pack(canon, valid)
+        )
+
+    def test_update_registers_matches_scatter(self, rng):
+        packed = _reference_pack(
+            hll.canonical_int64(rng.normal(size=20_000)),
+            np.ones(20_000, dtype=bool),
+        )
+        where = rng.random(20_000) > 0.3
+
+        native_regs = np.zeros(hll.M, dtype=np.int32)
+        assert native.hll_update_registers(packed, where, native_regs)
+
+        ref = np.zeros(hll.M, dtype=np.int32)
+        np.maximum.at(ref, packed >> 6, np.where(where, packed & 0x3F, 0))
+        assert np.array_equal(native_regs, ref)
+
+    def test_pack_codes_uses_identical_codes_either_path(self, rng, monkeypatch):
+        vals = rng.normal(size=10_000)
+        valid = rng.random(10_000) > 0.1
+        with_native = hll.pack_codes(vals, valid)
+        monkeypatch.setattr(native, "xxhash64_pack", lambda *_: None)
+        without_native = hll.pack_codes(vals, valid)
+        assert np.array_equal(with_native, without_native)
+
+
+def test_fallback_when_disabled(monkeypatch, rng):
+    monkeypatch.setattr(native, "xxhash64_pack", lambda *a: None)
+    monkeypatch.setattr(native, "hll_update_registers", lambda *a: False)
+    vals = rng.normal(size=1000)
+    valid = np.ones(1000, dtype=bool)
+    packed = hll.pack_codes(vals, valid)
+    assert packed.dtype == np.int32 and (packed != 0).any()
