@@ -57,7 +57,10 @@ from deequ_tpu.data.table import Column, ColumnType, Table
 
 
 def _f(xp, x):
-    """Cast mask/ints to the float dtype reductions run in."""
+    """Cast mask/ints to the float dtype reductions run in (no copy when
+    already that dtype on the host path)."""
+    if xp is np:
+        return np.asarray(x).astype(np.result_type(0.0), copy=False)
     return xp.asarray(x).astype(xp.result_type(0.0))
 
 
@@ -89,8 +92,10 @@ class Size(ScanShareableAnalyzer):
         return [where_spec(self.where)]
 
     def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
-        w = _f(xp, inputs[where_key(self.where)])
-        return {"n": xp.sum(w)}
+        w = inputs[where_key(self.where)]
+        if xp is np and np.asarray(w).dtype == np.bool_:
+            return {"n": float(np.count_nonzero(w))}  # host fold fast path
+        return {"n": xp.sum(_f(xp, w))}
 
     def merge_agg(self, a: Any, b: Any, xp) -> Any:
         return {"n": a["n"] + b["n"]}
@@ -142,8 +147,23 @@ class _RatioAnalyzer(ScanShareableAnalyzer):
         return self._extra_specs() + [where_spec(self.where), where_spec(None)]
 
     def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
-        w = _f(xp, inputs[where_key(self.where)])
-        m = _f(xp, inputs[self._match_mask_key()])
+        w_raw = inputs[where_key(self.where)]
+        m_raw = inputs[self._match_mask_key()]
+        if (
+            xp is np
+            and np.asarray(w_raw).dtype == np.bool_
+            and np.asarray(m_raw).dtype == np.bool_
+        ):
+            # host fold fast path: popcounts, no float materialization
+            w_b = np.asarray(w_raw)
+            guard = np.asarray(self._guard(inputs, np), dtype=bool)
+            return {
+                "matches": float(np.count_nonzero(np.asarray(m_raw) & w_b)),
+                "count": float(np.count_nonzero(w_b)),
+                "guard": float(np.count_nonzero(guard)),
+            }
+        w = _f(xp, w_raw)
+        m = _f(xp, m_raw)
         return {
             "matches": xp.sum(m * w),
             "count": xp.sum(w),
@@ -246,8 +266,10 @@ class Compliance(_RatioAnalyzer):
 
     def _guard(self, inputs: Dict[str, Any], xp):
         # criterion NULL on where-misses and NULL predicate results
-        w = _f(xp, inputs[where_key(self.where)])
-        return w * _f(xp, inputs[f"prednn:{self.predicate}"])
+        return xp.logical_and(
+            xp.asarray(inputs[where_key(self.where)]),
+            xp.asarray(inputs[f"prednn:{self.predicate}"]),
+        )
 
     def __repr__(self) -> str:
         return f"Compliance({self.instance_name},{self.predicate},{render_where(self.where)})"
@@ -332,8 +354,10 @@ class PatternMatch(_RatioAnalyzer):
 
     def _guard(self, inputs: Dict[str, Any], xp):
         # regexp_extract(NULL) is NULL: criterion non-NULL iff where ∧ value present
-        w = _f(xp, inputs[where_key(self.where)])
-        return w * _f(xp, inputs[f"valid:{self.column}"])
+        return xp.logical_and(
+            xp.asarray(inputs[where_key(self.where)]),
+            xp.asarray(inputs[f"valid:{self.column}"]),
+        )
 
     def __repr__(self) -> str:
         return f"PatternMatch({self.column},{self.pattern},{render_where(self.where)})"
@@ -363,6 +387,19 @@ class _NumericScanAnalyzer(ScanShareableAnalyzer):
         ]
 
     def _masked(self, inputs: Dict[str, Any], xp):
+        if xp is np:
+            # host fold: several analyzers share (column, where) — memo
+            # the mask product in the per-batch inputs dict
+            memo_key = f"__masked:{self.column}:{where_key(self.where)}"
+            cached = inputs.get(memo_key)
+            if cached is None:
+                x = np.asarray(inputs[f"num:{self.column}"])
+                m = _f(np, inputs[f"valid:{self.column}"]) * _f(
+                    np, inputs[where_key(self.where)]
+                )
+                cached = (x, m)
+                inputs[memo_key] = cached
+            return cached
         x = xp.asarray(inputs[f"num:{self.column}"])
         m = _f(xp, inputs[f"valid:{self.column}"]) * _f(
             xp, inputs[where_key(self.where)]
@@ -711,14 +748,23 @@ class DataType(ScanShareableAnalyzer):
     def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
         codes = xp.asarray(inputs[f"dtclass:{self.column}"])
         w = inputs[where_key(self.where)]
-        rows = _f(xp, inputs[where_key(None)])
+        rows = inputs[where_key(None)]
+        labels = ("null", "fractional", "integral", "boolean", "string")
+        if xp is np:
+            # host fold: one bincount pass instead of 5 comparison scans
+            sel_codes = np.where(
+                np.asarray(w, dtype=bool), codes, np.int32(_CODE_NULL)
+            )[np.asarray(rows, dtype=bool)]
+            counts_vec = np.bincount(sel_codes, minlength=len(labels))
+            return {
+                label: float(counts_vec[code]) for code, label in enumerate(labels)
+            }
+        rows_f = _f(xp, rows)
         # where-filtered rows -> NULL class; padded rows excluded via `rows`
         codes = xp.where(xp.asarray(w), codes, _CODE_NULL)
         counts = {}
-        for code, label in enumerate(
-            ("null", "fractional", "integral", "boolean", "string")
-        ):
-            counts[label] = xp.sum(_f(xp, codes == code) * rows)
+        for code, label in enumerate(labels):
+            counts[label] = xp.sum(_f(xp, codes == code) * rows_f)
         return counts
 
     def merge_agg(self, a: Any, b: Any, xp) -> Any:

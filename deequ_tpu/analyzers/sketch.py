@@ -249,6 +249,14 @@ class _QuantileAnalyzerBase(ScanShareableAnalyzer):
 
     def device_batch(self, inputs: Dict[str, Any], xp) -> Any:
         x = xp.asarray(inputs[f"num:{self.column}"])
+        if xp is np and x.size == 0:
+            # numpy does not clamp gathers on size-0 arrays like XLA does;
+            # a 0-row batch contributes an explicit empty artifact
+            return {
+                "sample": np.zeros(0, dtype=np.float64),
+                "n": np.zeros(1, dtype=np.float64),
+                "level": np.zeros(1, dtype=np.int32),
+            }
         m = (
             xp.asarray(inputs[f"valid:{self.column}"]).astype(x.dtype)
             * xp.asarray(inputs[where_key(getattr(self, "where", None))]).astype(

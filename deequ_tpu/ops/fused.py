@@ -255,6 +255,74 @@ def _to_f64(tree: Any) -> Any:
     )
 
 
+def fold_host_batch(
+    built: Dict[str, np.ndarray],
+    build_errors: Dict[str, BaseException],
+    host_members,
+    host_assisted,
+    host_member_keys,
+    host_aggs: Dict[int, Any],
+    host_assisted_states: Dict[int, Any],
+    host_errors: Dict[int, BaseException],
+) -> None:
+    """One batch's host-placed fold, shared by FusedScanPass and
+    DistributedScanPass: merge members run their xp-generic reduce with
+    numpy; assisted members (sketches) run the SAME per-batch computation
+    the device would (sort+decimate) and fold via host_consume. A failed
+    input fails only the members that need it."""
+    for i, member in host_members:
+        if i in host_errors:
+            continue
+        try:
+            for key in host_member_keys[i]:
+                if key in build_errors:
+                    raise build_errors[key]
+            agg = _to_f64(member.device_reduce(built, np))
+            prev = host_aggs.get(i)
+            host_aggs[i] = agg if prev is None else member.merge_agg(prev, agg, np)
+        except Exception as e:  # noqa: BLE001
+            host_errors[i] = e
+    for i, member in host_assisted:
+        if i in host_errors:
+            continue
+        try:
+            for key in host_member_keys[i]:
+                if key in build_errors:
+                    raise build_errors[key]
+            out = member.device_batch(built, np)
+            host_assisted_states[i] = member.host_consume(
+                host_assisted_states.get(i), out
+            )
+        except Exception as e:  # noqa: BLE001
+            host_errors[i] = e
+
+
+def materialize_host_results(
+    host_members,
+    host_assisted,
+    host_aggs: Dict[int, Any],
+    host_assisted_states: Dict[int, Any],
+    host_errors: Dict[int, BaseException],
+) -> Dict[int, "AnalyzerRunResult"]:
+    results: Dict[int, AnalyzerRunResult] = {}
+    for i, member in host_members:
+        if i in host_errors:
+            results[i] = AnalyzerRunResult(member, error=host_errors[i])
+        else:
+            try:
+                results[i] = AnalyzerRunResult(
+                    member, state=member.state_from_aggregates(host_aggs.get(i))
+                )
+            except Exception as e:  # noqa: BLE001
+                results[i] = AnalyzerRunResult(member, error=e)
+    for i, member in host_assisted:
+        if i in host_errors:
+            results[i] = AnalyzerRunResult(member, error=host_errors[i])
+        else:
+            results[i] = AnalyzerRunResult(member, state=host_assisted_states.get(i))
+    return results
+
+
 class PipelinedAggFold:
     """Cross-batch host fold that overlaps device compute with host work:
     each submitted batch output starts an async D2H copy, and the
@@ -335,12 +403,16 @@ class FusedScanPass:
         # 1. collect input specs; an analyzer whose spec construction fails
         #    (e.g. unparseable predicate) fails alone, not the pass.
         #    Placement (runtime.placement_mode): on a slow device link,
-        #    discrete analyzers (mask/code-only inputs) fold on the host
-        #    inside the SAME logical scan instead of shipping rows.
-        host_discrete = runtime.placement_mode() == "host-discrete"
+        #    discrete analyzers (mask/code-only inputs) — or, below the
+        #    bandwidth floor, EVERY analyzer — fold on the host inside
+        #    the SAME logical scan instead of shipping rows.
+        mode = runtime.placement_mode()
+        host_all = mode == "host-all"
+        host_discrete = host_all or mode == "host-discrete"
         merge_idx: List[int] = []
         assisted_idx: List[int] = []
         host_idx: List[int] = []
+        host_assisted_idx: List[int] = []
         results: Dict[int, AnalyzerRunResult] = {}
         specs: Dict[str, Any] = {}
         device_keys: set = set()
@@ -352,9 +424,15 @@ class FusedScanPass:
                 results[i] = AnalyzerRunResult(analyzer, error=e)
                 continue
             if getattr(analyzer, "device_assisted", False):
-                assisted_idx.append(i)
-                device_keys.update(s.key for s in analyzer_specs)
-            elif host_discrete and getattr(analyzer, "discrete_inputs", False):
+                if host_all:
+                    host_assisted_idx.append(i)
+                    host_keys[i] = [s.key for s in analyzer_specs]
+                else:
+                    assisted_idx.append(i)
+                    device_keys.update(s.key for s in analyzer_specs)
+            elif host_all or (
+                host_discrete and getattr(analyzer, "discrete_inputs", False)
+            ):
                 host_idx.append(i)
                 host_keys[i] = [s.key for s in analyzer_specs]
             else:
@@ -363,14 +441,15 @@ class FusedScanPass:
             for spec in analyzer_specs:
                 specs.setdefault(spec.key, spec)
 
-        if merge_idx or assisted_idx or host_idx:
+        if merge_idx or assisted_idx or host_idx or host_assisted_idx:
             merge_analyzers = [self.analyzers[i] for i in merge_idx]
             assisted = [self.analyzers[i] for i in assisted_idx]
             host_members = [(i, self.analyzers[i]) for i in host_idx]
+            host_assisted = [(i, self.analyzers[i]) for i in host_assisted_idx]
             try:
                 aggs, assisted_states, host_results, device_error = self._run_pass(
                     table, merge_analyzers, specs, assisted,
-                    device_keys, host_members, host_keys,
+                    device_keys, host_members, host_keys, host_assisted,
                 )
                 results.update(host_results)  # host outcomes stand on their own
                 if device_error is not None:
@@ -395,7 +474,7 @@ class FusedScanPass:
                     ):
                         results[i] = AnalyzerRunResult(analyzer, state=state)
             except Exception as e:  # noqa: BLE001
-                for i in merge_idx + assisted_idx + host_idx:
+                for i in merge_idx + assisted_idx + host_idx + host_assisted_idx:
                     results.setdefault(i, AnalyzerRunResult(self.analyzers[i], error=e))
 
         return [results[i] for i in range(len(self.analyzers))]
@@ -409,6 +488,7 @@ class FusedScanPass:
         device_keys=None,
         host_members=(),
         host_member_keys=None,
+        host_assisted=(),
     ):
         dtype = runtime.compute_dtype()
         if (
@@ -427,7 +507,10 @@ class FusedScanPass:
             "scan:"
             + ",".join(
                 a.name
-                for a in list(analyzers) + list(assisted) + [m for _, m in host_members]
+                for a in list(analyzers)
+                + list(assisted)
+                + [m for _, m in host_members]
+                + [m for _, m in host_assisted]
             )
         )
 
@@ -440,10 +523,12 @@ class FusedScanPass:
         host_errors: Dict[int, BaseException] = {}
         device_error: Optional[BaseException] = None
 
+        all_host = list(host_members) + list(host_assisted)
         if host_member_keys is None:
             host_member_keys = {
-                i: [s.key for s in member.input_specs()] for i, member in host_members
+                i: [s.key for s in member.input_specs()] for i, member in all_host
             }
+        host_assisted_states: Dict[int, Any] = {}
         sticky: Dict[str, Any] = {}
         for batch in table.batches(self.batch_size):
             # per-key builds with error capture: a failing input (e.g. a
@@ -454,11 +539,11 @@ class FusedScanPass:
             live_keys: set = set()
             if use_device and device_error is None:
                 live_keys.update(device_spec_keys)
-            for i, _member in host_members:
+            for i, _member in all_host:
                 if i not in host_errors:
                     live_keys.update(host_member_keys[i])
             device_live = use_device and device_error is None
-            host_live = any(i not in host_errors for i, _m in host_members)
+            host_live = any(i not in host_errors for i, _m in all_host)
             if not device_live and not host_live:
                 break  # everything already failed; stop scanning
             built: Dict[str, np.ndarray] = {}
@@ -486,20 +571,10 @@ class FusedScanPass:
                     fold.submit(fused(packed_inputs), meta_box)
                 except Exception as e:  # noqa: BLE001
                     device_error = e
-            for i, member in host_members:
-                if i in host_errors:
-                    continue
-                try:
-                    for key in host_member_keys[i]:
-                        if key in build_errors:
-                            raise build_errors[key]
-                    agg = _to_f64(member.device_reduce(built, np))
-                    prev = host_aggs.get(i)
-                    host_aggs[i] = (
-                        agg if prev is None else member.merge_agg(prev, agg, np)
-                    )
-                except Exception as e:  # noqa: BLE001
-                    host_errors[i] = e
+            fold_host_batch(
+                built, build_errors, host_members, host_assisted,
+                host_member_keys, host_aggs, host_assisted_states, host_errors,
+            )
 
         aggs, assisted_states = [], []
         if device_error is None:
@@ -509,15 +584,7 @@ class FusedScanPass:
                 aggs, assisted_states = fold.finish()
             except Exception as e:  # noqa: BLE001
                 device_error = e
-        host_results: Dict[int, AnalyzerRunResult] = {}
-        for i, member in host_members:
-            if i in host_errors:
-                host_results[i] = AnalyzerRunResult(member, error=host_errors[i])
-            else:
-                try:
-                    host_results[i] = AnalyzerRunResult(
-                        member, state=member.state_from_aggregates(host_aggs.get(i))
-                    )
-                except Exception as e:  # noqa: BLE001
-                    host_results[i] = AnalyzerRunResult(member, error=e)
+        host_results = materialize_host_results(
+            host_members, host_assisted, host_aggs, host_assisted_states, host_errors
+        )
         return aggs, assisted_states, host_results, device_error

@@ -46,11 +46,16 @@ def _cache_dirs():
 
 def _build_library() -> Optional[str]:
     """Compile the kernel; atomic tmp+rename so concurrent processes
-    (the normal multihost case) never observe a half-written library."""
-    src_mtime = os.path.getmtime(_SOURCE)
+    (the normal multihost case) never observe a half-written library.
+    The output name embeds a hash of the C source, so different package
+    versions sharing a cache dir never load each other's kernels."""
+    import hashlib
+
+    with open(_SOURCE, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
     for directory in _cache_dirs():
-        out = os.path.join(directory, "_deequ_native.so")
-        if os.path.exists(out) and os.path.getmtime(out) >= src_mtime:
+        out = os.path.join(directory, f"_deequ_native_{digest}.so")
+        if os.path.exists(out):
             return out
         for compiler in ("cc", "gcc", "clang"):
             tmp = None

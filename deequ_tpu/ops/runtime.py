@@ -37,9 +37,13 @@ MAX_F32_EXACT_COUNT_BATCH = 1 << 24  # f32 integers exact below 2^24
 # ---------------------------------------------------------------------------
 
 _PLACEMENT_CACHE: Optional[str] = None
-# below this measured host->device bandwidth, discrete (mask/code-only)
-# reductions cost more to ship than to fold on the host
-PLACEMENT_BANDWIDTH_FLOOR = 100e6  # bytes/s
+# Cost model, bytes vs FLOPs: a value reduction ships ~4 B/row and costs
+# ~2 ns/row on the host, so the device only wins above ~2 GB/s links
+# (PCIe/ICI-attached accelerators). Discrete (mask/code-only) reductions
+# ship ~0.1-2 B/row against ~1 ns/row of host popcount, breaking even
+# around 100 MB/s.
+PLACEMENT_DEVICE_ALL_BANDWIDTH = 2e9  # bytes/s: everything on device
+PLACEMENT_BANDWIDTH_FLOOR = 100e6  # bytes/s: below, nothing earns the wire
 
 
 def measure_device_bandwidth(nbytes: int = 4 << 20) -> float:
@@ -58,16 +62,22 @@ def measure_device_bandwidth(nbytes: int = 4 << 20) -> float:
 
 
 def placement_mode() -> str:
-    """'device' (everything in the fused XLA pass) or 'host-discrete'
-    (mask/code-only reductions fold on the host; value reductions stay
-    on device).
+    """Where reductions run, by measured link economics:
 
-    The scheduler analogue of Spark's map-side combine decision: a
-    discrete analyzer consumes ~1-2 bytes/row of masks or dictionary
-    codes and produces a tiny state — when the link to the device moves
-    fewer bytes/s than the host can simply *reduce*, shipping those rows
-    is a loss. Auto-measures once per process; override with
-    DEEQU_TPU_PLACEMENT=device|host|auto.
+      'device'        — everything in the fused XLA pass (fast links:
+                        PCIe/ICI-attached chips, or CPU-backend jax where
+                        "transfer" is a memcpy)
+      'host-discrete' — mask/code-only reductions fold on the host;
+                        value-dense work (moments, sorts) still earns its
+                        4 B/row on a mid-speed link
+      'host-all'      — the link is slower than the host can simply
+                        REDUCE (e.g. a ~10 MB/s tunnel): every analyzer
+                        folds on the host through the same xp-generic
+                        reduction code; the device program is skipped
+
+    The scheduler analogue of Spark's map-side combine decision, decided
+    by a one-shot synchronized bandwidth probe per process. Override with
+    DEEQU_TPU_PLACEMENT=device|host-discrete|host|auto ('host' = host-all).
     """
     global _PLACEMENT_CACHE
     import os
@@ -75,17 +85,22 @@ def placement_mode() -> str:
     env = os.environ.get("DEEQU_TPU_PLACEMENT", "auto")
     if env == "device":
         return "device"
-    if env == "host":
+    if env in ("host", "host-all"):
+        return "host-all"
+    if env == "host-discrete":
         return "host-discrete"
     if _PLACEMENT_CACHE is None:
         try:
             bandwidth = measure_device_bandwidth()
         except Exception:  # noqa: BLE001 - no device at all -> host
-            _PLACEMENT_CACHE = "host-discrete"
+            _PLACEMENT_CACHE = "host-all"
             return _PLACEMENT_CACHE
-        _PLACEMENT_CACHE = (
-            "device" if bandwidth >= PLACEMENT_BANDWIDTH_FLOOR else "host-discrete"
-        )
+        if bandwidth >= PLACEMENT_DEVICE_ALL_BANDWIDTH:
+            _PLACEMENT_CACHE = "device"
+        elif bandwidth >= PLACEMENT_BANDWIDTH_FLOOR:
+            _PLACEMENT_CACHE = "host-discrete"
+        else:
+            _PLACEMENT_CACHE = "host-all"
     return _PLACEMENT_CACHE
 
 

@@ -77,7 +77,15 @@ def canonical_int64(values: np.ndarray) -> np.ndarray:
     """Canonical 8-byte form whose xxhash64 defines a value's identity:
     floats by their float64 bit pattern, timestamps as epoch-us, ints and
     bools as int64 (reference: the Catalyst kernel hashes the raw 8-byte
-    value the same way, StatefulHyperloglogPlus.scala:86-115)."""
+    value the same way, StatefulHyperloglogPlus.scala:86-115).
+
+    Strings have no 8-byte canonical form — they go through the
+    dictionary + hash_strings path (pack_codes handles the dispatch)."""
+    if values.dtype == object or values.dtype.kind == "U":
+        raise TypeError(
+            "string values have no canonical int64 form; use the "
+            "dictionary hash path"
+        )
     if values.dtype == np.bool_:
         return values.astype(np.int64)
     if np.issubdtype(values.dtype, np.floating):
@@ -92,8 +100,18 @@ def pack_codes(values: np.ndarray, valid: np.ndarray) -> np.ndarray:
 
     The one-pass C kernel (ops/native) does hash+clz+pack at memory
     speed; the numpy fallback computes the identical codes in ~15
-    vectorized passes."""
+    vectorized passes. String dtypes (object or numpy-unicode) hash via
+    the unique-dictionary path instead."""
     from deequ_tpu.ops import native
+
+    if values.dtype == object or values.dtype.kind == "U":
+        from deequ_tpu.ops.strings import hash_strings
+
+        uniques, inv = np.unique(values[valid].astype(str), return_inverse=True)
+        idx, rank = registers_from_hashes(hash_strings(uniques))
+        packed = np.zeros(len(values), dtype=np.int32)
+        packed[valid] = ((idx << 6) | rank)[inv]
+        return packed
 
     canon = canonical_int64(values)
     packed = native.xxhash64_pack(canon, valid)

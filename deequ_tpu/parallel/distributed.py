@@ -31,7 +31,8 @@ from deequ_tpu.ops.fused import (
     AnalyzerRunResult,
     PipelinedAggFold,
     _pad_size,
-    _to_f64,
+    fold_host_batch,
+    materialize_host_results,
 )
 
 DATA_AXIS = "data"
@@ -119,14 +120,17 @@ class DistributedScanPass:
 
     def run(self, table: Table) -> List[AnalyzerRunResult]:
         # same placement policy as FusedScanPass: on a slow device link,
-        # discrete (mask/code-only) analyzers fold on the host while the
-        # mesh reduces the value-dense ones
-        host_discrete = runtime.placement_mode() == "host-discrete"
+        # discrete (mask/code-only) analyzers — or under 'host-all',
+        # every analyzer — fold on the host while the mesh reduces the rest
+        mode = runtime.placement_mode()
+        host_all = mode == "host-all"
+        host_discrete = host_all or mode == "host-discrete"
         merge_analyzers: List[ScanShareableAnalyzer] = []
         merge_idx: List[int] = []
         assisted: List[ScanShareableAnalyzer] = []
         assisted_idx: List[int] = []
         host_members: List[tuple] = []
+        host_assisted: List[tuple] = []
         host_member_keys: Dict[int, List[str]] = {}
         results: Dict[int, AnalyzerRunResult] = {}
         specs: Dict[str, Any] = {}
@@ -140,11 +144,16 @@ class DistributedScanPass:
                 continue
             for spec in analyzer_specs:
                 specs.setdefault(spec.key, spec)
-            if getattr(analyzer, "device_assisted", False):
+            if getattr(analyzer, "device_assisted", False) and not host_all:
                 assisted.append(analyzer)
                 assisted_idx.append(i)
                 device_keys.update(s.key for s in analyzer_specs)
-            elif host_discrete and getattr(analyzer, "discrete_inputs", False):
+            elif getattr(analyzer, "device_assisted", False):
+                host_assisted.append((i, analyzer))
+                host_member_keys[i] = [s.key for s in analyzer_specs]
+            elif host_all or (
+                host_discrete and getattr(analyzer, "discrete_inputs", False)
+            ):
                 host_members.append((i, analyzer))
                 host_member_keys[i] = [s.key for s in analyzer_specs]
             else:
@@ -171,6 +180,7 @@ class DistributedScanPass:
         )
 
         host_aggs: Dict[int, Any] = {}
+        host_assisted_states: Dict[int, Any] = {}
         host_errors: Dict[int, BaseException] = {}
         try:
             fold = PipelinedAggFold(merge_analyzers, assisted, n_dev=n_devices)
@@ -186,7 +196,7 @@ class DistributedScanPass:
                 if device_live:
                     live_keys.update(device_keys)
                 host_live = False
-                for i, _m in host_members:
+                for i, _m in host_members + host_assisted:
                     if i not in host_errors:
                         host_live = True
                         live_keys.update(host_member_keys[i])
@@ -221,20 +231,11 @@ class DistributedScanPass:
                         fold.submit(fn(inputs))
                     except Exception as e:  # noqa: BLE001
                         device_error = e
-                for i, member in host_members:
-                    if i in host_errors:
-                        continue
-                    try:
-                        for key in host_member_keys[i]:
-                            if key in build_errors:
-                                raise build_errors[key]
-                        agg = _to_f64(member.device_reduce(built, np))
-                        prev = host_aggs.get(i)
-                        host_aggs[i] = (
-                            agg if prev is None else member.merge_agg(prev, agg, np)
-                        )
-                    except Exception as e:  # noqa: BLE001
-                        host_errors[i] = e
+                fold_host_batch(
+                    built, build_errors, host_members, host_assisted,
+                    host_member_keys, host_aggs, host_assisted_states,
+                    host_errors,
+                )
             aggs, assisted_states = [], []
             if device_error is None:
                 try:
@@ -256,17 +257,12 @@ class DistributedScanPass:
                         results[i] = AnalyzerRunResult(analyzer, error=e)
                 for i, state in zip(assisted_idx, assisted_states):
                     results[i] = AnalyzerRunResult(self.analyzers[i], state=state)
-            for i, member in host_members:
-                if i in host_errors:
-                    results[i] = AnalyzerRunResult(member, error=host_errors[i])
-                else:
-                    try:
-                        results[i] = AnalyzerRunResult(
-                            member,
-                            state=member.state_from_aggregates(host_aggs.get(i)),
-                        )
-                    except Exception as e:  # noqa: BLE001
-                        results[i] = AnalyzerRunResult(member, error=e)
+            results.update(
+                materialize_host_results(
+                    host_members, host_assisted, host_aggs,
+                    host_assisted_states, host_errors,
+                )
+            )
         except Exception as e:  # noqa: BLE001
             for i in range(len(self.analyzers)):
                 results.setdefault(i, AnalyzerRunResult(self.analyzers[i], error=e))

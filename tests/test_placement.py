@@ -139,3 +139,15 @@ def test_distributed_host_placement_parity(monkeypatch):
         assert dev.metric_map[a].value.get() == pytest.approx(
             host.metric_map[a].value.get(), rel=1e-12
         ), a
+
+
+def test_host_all_runs_everything_without_device(mixed_table, monkeypatch):
+    """Below the bandwidth floor, EVERY analyzer — including the
+    device-assisted quantile sketch — folds on the host: zero launches,
+    one logical pass, same metrics (parity asserted above)."""
+    monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "host")
+    with runtime.monitored() as stats:
+        results = FusedScanPass(ANALYZERS, batch_size=1024).run(mixed_table)
+    assert all(r.error is None for r in results)
+    assert stats.device_passes == 1
+    assert stats.device_launches == 0
