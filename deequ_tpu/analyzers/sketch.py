@@ -71,8 +71,10 @@ def _hll_spec(column: str) -> InputSpec:
     rank 0 — a no-op for the scatter-max)."""
 
     def build(t: Table) -> np.ndarray:
+        from deequ_tpu.data.table import ColumnType
+
         col = t.column(column)
-        if col.values.dtype == object:
+        if col.ctype == ColumnType.STRING:
             # share the batch's dict-encode; hash unique strings only
             from deequ_tpu.ops.strings import hash_strings
 

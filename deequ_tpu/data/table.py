@@ -39,7 +39,6 @@ NUMPY_BACKING = {
 }
 
 
-@dataclass
 class Column:
     """One column: dense values + validity mask (True = present).
 
@@ -47,21 +46,42 @@ class Column:
     epoch) — never NaN — so masked reductions can consume the backing
     array directly (0 * mask == 0; NaN would poison every sum). All
     constructors enforce this; build Columns through them.
+
+    `values` may be passed as a zero-arg callable for LAZY
+    materialization: streamed string columns keep their Arrow backing
+    (dictionary codes serve the analyzers) and only pay the
+    object-array conversion if something truly needs per-row Python
+    strings.
     """
 
-    name: str
-    ctype: ColumnType
-    values: np.ndarray
-    valid: np.ndarray
-
-    def __post_init__(self):
-        assert len(self.values) == len(self.valid)
+    def __init__(self, name: str, ctype: ColumnType, values, valid: np.ndarray):
+        self.name = name
+        self.ctype = ctype
+        self.valid = valid
+        if callable(values):
+            self._values = None
+            self._values_fn = values
+        else:
+            assert len(values) == len(valid)
+            self._values = values
+            self._values_fn = None
         # per-instance memo for derived encodings (dict codes, parsed
         # numerics) shared by every analyzer reading this batch's column
-        object.__setattr__(self, "_cache", {})
+        self._cache: Dict[str, object] = {}
+
+    @property
+    def values(self) -> np.ndarray:
+        if self._values is None:
+            materialized = self._values_fn()
+            assert len(materialized) == len(self.valid)
+            self._values = materialized
+        return self._values
+
+    def __repr__(self) -> str:
+        return f"Column({self.name!r}, {self.ctype})"
 
     def __len__(self) -> int:
-        return len(self.values)
+        return len(self.valid)
 
     @property
     def null_count(self) -> int:
@@ -72,12 +92,17 @@ class Column:
 
     def slice(self, start: int, stop: int) -> "Column":
         child = Column(
-            self.name, self.ctype, self.values[start:stop], self.valid[start:stop]
+            self.name,
+            self.ctype,
+            # lazy through the slice: only materialize the parent if the
+            # child's python-object values are actually consumed
+            lambda: self.values[start:stop],
+            self.valid[start:stop],
         )
         # derived encodings (dict codes, parsed numerics) are row-wise, so
         # a slice can reuse the parent's arrays — string columns are then
         # encoded ONCE per table, not once per batch per pass
-        object.__setattr__(child, "_parent", (self, start, stop))
+        child._parent = (self, start, stop)
         return child
 
     def take(self, indices: np.ndarray) -> "Column":
