@@ -187,21 +187,7 @@ def pack_batch_inputs(built_items, padded: int, dtype, sticky=None, num_rows=Non
                 (key, "bits", bits)
             )
         elif np.issubdtype(arr.dtype, np.integer):
-            chosen = np.dtype(sticky.get(key, np.int8))
-            if arr.size:
-                mn, mx = int(arr.min()), int(arr.max())
-                for cand in (chosen.type, np.int16, np.int32, np.int64):
-                    info = np.iinfo(cand)
-                    if (
-                        np.dtype(cand).itemsize >= chosen.itemsize
-                        and info.min <= mn
-                        and mx <= info.max
-                    ):
-                        chosen = np.dtype(cand)
-                        break
-            chosen = np.dtype(min(chosen, arr.dtype, key=lambda d: np.dtype(d).itemsize))
-            sticky[key] = chosen
-            arr = arr.astype(chosen, copy=False)
+            arr = runtime.narrow_int_wire(arr, key, sticky)
             entries_by_group.setdefault((arr.dtype.name, "int"), []).append(
                 (key, "int", arr)
             )
@@ -491,10 +477,14 @@ class FusedScanPass:
         host_assisted=(),
     ):
         dtype = runtime.compute_dtype()
+        use_device = bool(analyzers or assisted)
         if (
-            np.dtype(dtype) == np.float32
+            use_device
+            and np.dtype(dtype) == np.float32
             and self.batch_size > runtime.MAX_F32_EXACT_COUNT_BATCH
         ):
+            # only the packed f32 device transfer loses exactness; pure
+            # host placement folds in float64 and takes any batch size
             raise ValueError(
                 f"batch_size={self.batch_size} exceeds "
                 f"{runtime.MAX_F32_EXACT_COUNT_BATCH} (2^24): per-batch "
@@ -516,7 +506,6 @@ class FusedScanPass:
 
         fold = PipelinedAggFold(analyzers, assisted)
         device_spec_keys = sorted(device_keys)
-        use_device = bool(analyzers or assisted)
 
         # host fold state: per host member, (f64 aggregate, error)
         host_aggs: Dict[int, Any] = {}

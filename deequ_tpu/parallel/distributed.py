@@ -64,6 +64,16 @@ def _get_distributed_fn(analyzers, mesh: Mesh, axis_name: str, assisted=()):
     n_devices = mesh.shape[axis_name]
 
     def per_device(inputs):
+        # wire-narrowed ints (1-2 B/row on the put) widen back to int32
+        # before reduction, matching the fused engine's unpack stage
+        inputs = {
+            k: (
+                v.astype(jnp.int32)
+                if jnp.issubdtype(v.dtype, jnp.integer) and v.dtype.itemsize < 4
+                else v
+            )
+            for k, v in inputs.items()
+        }
         # local shard reduce: identical computation to the single-chip pass
         partials = tuple(a.device_reduce(inputs, jnp) for a in analyzers)
 
@@ -182,6 +192,7 @@ class DistributedScanPass:
         host_aggs: Dict[int, Any] = {}
         host_assisted_states: Dict[int, Any] = {}
         host_errors: Dict[int, BaseException] = {}
+        sticky: Dict[str, Any] = {}
         try:
             fold = PipelinedAggFold(merge_analyzers, assisted, n_dev=n_devices)
 
@@ -221,10 +232,11 @@ class DistributedScanPass:
                         inputs: Dict[str, Any] = {}
                         for key in device_keys:
                             arr = runtime.pad_to(built[key], padded)
-                            if not (
-                                arr.dtype == np.bool_
-                                or np.issubdtype(arr.dtype, np.integer)
-                            ):
+                            if np.issubdtype(arr.dtype, np.integer):
+                                arr = runtime.narrow_int_wire(
+                                    arr, key, sticky
+                                )
+                            elif arr.dtype != np.bool_:
                                 arr = arr.astype(dtype)
                             inputs[key] = jax.device_put(arr, in_sharding[key])
                         runtime.record_launch()

@@ -101,6 +101,16 @@ _STATE = b"\x01"  # serialized state follows
 _FAILED = b"\x02"  # analyzer failed on that host; utf-8 message follows
 
 
+def analyzer_list_digest(analyzers: Sequence[Analyzer]) -> bytes:
+    """8-byte digest of the (deduped, ordered) analyzer list that leads
+    every state envelope; all hosts must produce the same digest."""
+    import hashlib
+
+    return hashlib.sha1(
+        "\x1f".join(repr(a) for a in analyzers).encode("utf-8")
+    ).digest()[:8]
+
+
 def _dedup(analyzers: Sequence[Analyzer]) -> List[Analyzer]:
     seen = set()
     unique: List[Analyzer] = []
@@ -141,7 +151,12 @@ def merge_states_across_hosts(
     errors = {}
     local_errors = local_errors or {}
 
-    parts: List[bytes] = []
+    # The envelope decodes positionally against the local analyzer list;
+    # if hosts ran differently ordered/composed lists, two same-size
+    # payloads could silently swap. The leading digest must match on
+    # every host.
+    digest = analyzer_list_digest(analyzers)
+    parts: List[bytes] = [digest]
     for analyzer in analyzers:
         if analyzer in local_errors:
             payload = _FAILED + str(local_errors[analyzer]).encode("utf-8")
@@ -155,7 +170,14 @@ def merge_states_across_hosts(
     envelope = b"".join(parts)
 
     for host_envelope in gather(envelope):
-        offset = 0
+        if host_envelope[:8] != digest:
+            raise ValueError(
+                "multihost analyzer-list mismatch: a host sent a state "
+                "envelope for a different analyzer set/order; all hosts "
+                "must pass identical analyzer lists to "
+                "merge_states_across_hosts."
+            )
+        offset = 8
         for analyzer in analyzers:
             (length,) = struct.unpack(">i", host_envelope[offset : offset + 4])
             offset += 4

@@ -75,6 +75,14 @@ def make_table(seed: int, n: int = 3000) -> Table:
     return Table.from_numpy(make_arrays(seed, n))
 
 
+def envelope(analyzers, blobs) -> bytes:
+    import struct
+
+    return multihost.analyzer_list_digest(analyzers) + b"".join(
+        struct.pack(">i", len(b)) + b for b in blobs
+    )
+
+
 def test_serialize_state_round_trips_every_analyzer():
     table = make_table(0)
     provider = InMemoryStateProvider()
@@ -116,11 +124,6 @@ def test_multihost_merge_equals_whole_table():
         AnalysisRunner.do_analysis_run(part, ALL_ANALYZERS, save_states_with=provider)
         local_providers.append(provider)
 
-    import struct
-
-    def envelope(blobs):
-        return b"".join(struct.pack(">i", len(b)) + b for b in blobs)
-
     def fake_gather_for(host_idx):
         def gather(payload: bytes):
             # every host contributes its serialized state for the SAME
@@ -134,7 +137,7 @@ def test_multihost_merge_equals_whole_table():
                     if state is None
                     else b"\x01" + serialize_state(analyzer, state)
                 )
-                envelopes.append(envelope([blob]))
+                envelopes.append(envelope([analyzer], [blob]))
             assert envelopes[host_idx] == payload
             return envelopes
 
@@ -190,12 +193,10 @@ def test_host_failure_fails_global_metric():
     not silently shrink it to the healthy hosts' data."""
     table = make_table(4)
 
-    import struct
-
     def gather_with_remote_failure(payload: bytes):
         # host 1 reports a failure for BOTH analyzers in the envelope
         blob = b"\x02" + b"boom on host 1"
-        failing = b"".join([struct.pack(">i", len(blob)) + blob] * 2)
+        failing = envelope([Size(), Mean("x")], [blob, blob])
         return [payload, failing]
 
     ctx = multihost.run_multihost_analysis(
@@ -220,20 +221,39 @@ def test_local_failure_propagates_but_empty_partition_does_not():
 
     all_null = T.from_numpy({"x": np.full(10, np.nan)})
 
-    import struct
-
     def gather_with_data_elsewhere(payload: bytes):
         other = InMemoryStateProvider()
         AnalysisRunner.do_analysis_run(
             make_table(6), [Mean("x")], save_states_with=other
         )
         blob = b"\x01" + serialize_state(Mean("x"), other.load(Mean("x")))
-        return [payload, struct.pack(">i", len(blob)) + blob]
+        return [payload, envelope([Mean("x")], [blob])]
 
     ctx2 = multihost.run_multihost_analysis(
         all_null, [Mean("x")], gather=gather_with_data_elsewhere
     )
     assert ctx2.metric_map[Mean("x")].value.is_success
+
+
+def test_envelope_digest_mismatch_raises():
+    """Hosts running differently ordered/composed analyzer lists must get
+    a hard error, not silently swapped same-size states."""
+    table = make_table(7, n=100)
+    provider = InMemoryStateProvider()
+    AnalysisRunner.do_analysis_run(
+        table, [Size(), Sum("x")], save_states_with=provider
+    )
+
+    def gather_wrong_order(payload: bytes):
+        # the "other host" deduped to a different order: digest differs
+        blob = b"\x01" + serialize_state(Size(), provider.load(Size()))
+        blob2 = b"\x01" + serialize_state(Sum("x"), provider.load(Sum("x")))
+        return [payload, envelope([Sum("x"), Size()], [blob2, blob])]
+
+    with pytest.raises(ValueError, match="analyzer-list mismatch"):
+        multihost.merge_states_across_hosts(
+            [Size(), Sum("x")], provider, gather=gather_wrong_order
+        )
 
 
 def test_duplicate_analyzers_merge_once():
