@@ -311,14 +311,13 @@ def _match_spec(column: str, pattern: str) -> InputSpec:
     def build(t: Table) -> np.ndarray:
         from deequ_tpu.ops.strings import match_pattern
 
+        from deequ_tpu.data.table import gather_with_null
+
         col = t.column(column)
-        # regex only the unique values (typically << rows), gather to rows
+        # regex only the unique values (typically << rows), gather to
+        # rows; null rows map to False
         codes, uniques = col.dict_encode()
-        unique_hit = match_pattern(uniques, pattern)
-        out = np.zeros(len(col), dtype=np.bool_)
-        sel = codes >= 0
-        out[sel] = unique_hit[codes[sel]]
-        return out
+        return gather_with_null(match_pattern(uniques, pattern), codes, False)
 
     return InputSpec(key=f"match:{column}:{pattern}", build=build)
 
@@ -406,6 +405,58 @@ class _NumericScanAnalyzer(ScanShareableAnalyzer):
         )
         return x, m
 
+    def _moments(self, inputs: Dict[str, Any]) -> Dict[str, float]:
+        """Host-fold fast path: ONE fused traversal per (column, where)
+        family per batch computes count/sum/min/max/m2, shared by
+        Mean/Sum/Minimum/Maximum/StandardDeviation via a per-batch memo —
+        the host analogue of the device pass where XLA CSE shares the
+        masked subexpressions. Native C when available, compacted numpy
+        otherwise; both match the generic formulas within 1e-12."""
+        memo_key = f"__moments:{self.column}:{where_key(self.where)}"
+        cached = inputs.get(memo_key)
+        if cached is None:
+            from deequ_tpu.ops import native
+
+            x = np.asarray(inputs[f"num:{self.column}"])
+            valid = np.asarray(inputs[f"valid:{self.column}"])
+            where = (
+                None
+                if self.where is None
+                else np.asarray(inputs[where_key(self.where)])
+            )
+            out = None
+            if x.dtype == np.float64 and valid.dtype == np.bool_ and (
+                where is None or where.dtype == np.bool_
+            ):
+                out = native.masked_moments(x, valid, where)
+            if out is not None:
+                cached = {
+                    "count": float(out[0]),
+                    "sum": float(out[1]),
+                    "min": float(out[2]),
+                    "max": float(out[3]),
+                    "m2": float(out[4]),
+                }
+            else:
+                mask = (
+                    valid.astype(bool)
+                    if where is None
+                    else (valid.astype(bool) & where.astype(bool))
+                )
+                xm = np.asarray(x, dtype=np.float64)[mask]
+                count = float(xm.size)
+                total = float(xm.sum()) if xm.size else 0.0
+                avg = total / max(count, 1.0)
+                cached = {
+                    "count": count,
+                    "sum": total,
+                    "min": float(xm.min()) if xm.size else float("inf"),
+                    "max": float(xm.max()) if xm.size else float("-inf"),
+                    "m2": float(((xm - avg) ** 2).sum()) if xm.size else 0.0,
+                }
+            inputs[memo_key] = cached
+        return cached
+
     def compute_metric_from(self, state: Optional[State]) -> Metric:
         if state is None:
             return self.empty_state_failure()
@@ -426,6 +477,9 @@ class Mean(_NumericScanAnalyzer):
         return "Mean"
 
     def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        if xp is np:
+            mom = self._moments(inputs)
+            return {"total": mom["sum"], "count": mom["count"]}
         x, m = self._masked(inputs, xp)
         return {"total": xp.sum(x * m), "count": xp.sum(m)}
 
@@ -453,6 +507,9 @@ class Sum(_NumericScanAnalyzer):
         return "Sum"
 
     def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        if xp is np:
+            mom = self._moments(inputs)
+            return {"sum": mom["sum"], "count": mom["count"]}
         x, m = self._masked(inputs, xp)
         return {"sum": xp.sum(x * m), "count": xp.sum(m)}
 
@@ -480,6 +537,9 @@ class Minimum(_NumericScanAnalyzer):
         return "Minimum"
 
     def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        if xp is np:
+            mom = self._moments(inputs)
+            return {"min": mom["min"], "count": mom["count"]}
         x, m = self._masked(inputs, xp)
         masked = xp.where(m > 0, x, xp.inf)
         return {"min": xp.min(masked), "count": xp.sum(m)}
@@ -508,6 +568,9 @@ class Maximum(_NumericScanAnalyzer):
         return "Maximum"
 
     def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        if xp is np:
+            mom = self._moments(inputs)
+            return {"max": mom["max"], "count": mom["count"]}
         x, m = self._masked(inputs, xp)
         masked = xp.where(m > 0, x, -xp.inf)
         return {"max": xp.max(masked), "count": xp.sum(m)}
@@ -540,6 +603,14 @@ class StandardDeviation(_NumericScanAnalyzer):
         return "StandardDeviation"
 
     def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        if xp is np:
+            mom = self._moments(inputs)
+            n = mom["count"]
+            return {
+                "n": n,
+                "avg": mom["sum"] / n if n > 0 else 0.0,
+                "m2": mom["m2"],
+            }
         x, m = self._masked(inputs, xp)
         n = xp.sum(m)
         safe_n = xp.maximum(n, 1.0)
@@ -701,12 +772,15 @@ def _dtclass_spec(column: str) -> InputSpec:
 
         col = t.column(column)
         if col.ctype == ColumnType.STRING:
+            # classify unique strings only; null rows map to the NULL
+            # class. int8: 5 classes, and the narrow dtype is both the
+            # wire format and the host bincount fast path
+            from deequ_tpu.data.table import gather_with_null
+
             dict_codes, uniques = col.dict_encode()
-            unique_codes = classify(uniques)
-            codes = np.zeros(len(col), dtype=np.int32)
-            sel = dict_codes >= 0
-            codes[sel] = unique_codes[dict_codes[sel]]
-            return codes
+            return gather_with_null(
+                classify(uniques).astype(np.int8), dict_codes, _CODE_NULL
+            )
         # typed columns classify statically from the stringified form
         static = {
             ColumnType.LONG: _CODE_INTEGRAL,
@@ -715,7 +789,7 @@ def _dtclass_spec(column: str) -> InputSpec:
             ColumnType.BOOLEAN: _CODE_BOOLEAN,
             ColumnType.TIMESTAMP: _CODE_STRING,
         }[col.ctype]
-        return np.where(col.valid, np.int32(static), np.int32(_CODE_NULL))
+        return np.where(col.valid, np.int8(static), np.int8(_CODE_NULL))
 
     return InputSpec(key=f"dtclass:{column}", build=build)
 
@@ -751,11 +825,35 @@ class DataType(ScanShareableAnalyzer):
         rows = inputs[where_key(None)]
         labels = ("null", "fractional", "integral", "boolean", "string")
         if xp is np:
-            # host fold: one bincount pass instead of 5 comparison scans
-            sel_codes = np.where(
-                np.asarray(w, dtype=bool), codes, np.int32(_CODE_NULL)
-            )[np.asarray(rows, dtype=bool)]
-            counts_vec = np.bincount(sel_codes, minlength=len(labels))
+            # host fold: one bincount pass instead of 5 comparison scans;
+            # where-filtered rows count as NULL class (conditionalSelection
+            # semantics), padded rows (rows=False) drop out entirely
+            from deequ_tpu.ops import native
+
+            sel_codes = np.asarray(codes)
+            w_arr = np.asarray(w, dtype=bool)
+            rows_arr = np.asarray(rows, dtype=bool)
+            w_all = bool(w_arr.all())
+            rows_all = bool(rows_arr.all())
+            if w_all and rows_all:
+                mask = None
+            elif w_all:
+                mask = rows_arr
+            elif rows_all:
+                mask = w_arr
+            else:
+                mask = w_arr & rows_arr
+            counts_vec = native.bincount(sel_codes, len(labels), where=mask)
+            if counts_vec is None:
+                if mask is not None:
+                    sel_codes = sel_codes[mask]
+                counts_vec = np.bincount(sel_codes, minlength=len(labels))
+            if not w_all:
+                # rows present but excluded by `where` classify as NULL
+                n_rows = int(np.count_nonzero(rows_arr)) if not rows_all else len(rows_arr)
+                n_in = int(counts_vec.sum())
+                counts_vec = counts_vec.copy()
+                counts_vec[_CODE_NULL] += n_rows - n_in
             return {
                 label: float(counts_vec[code]) for code, label in enumerate(labels)
             }

@@ -75,15 +75,17 @@ def _hll_spec(column: str) -> InputSpec:
 
         col = t.column(column)
         if col.ctype == ColumnType.STRING:
-            # share the batch's dict-encode; hash unique strings only
+            # share the batch's dict-encode; hash unique strings only;
+            # null rows map to packed code 0 (idx 0, rank 0 — a no-op
+            # for the scatter-max)
+            from deequ_tpu.data.table import gather_with_null
             from deequ_tpu.ops.strings import hash_strings
 
             codes, uniques = col.dict_encode()
             idx_u, rank_u = hll.registers_from_hashes(hash_strings(uniques))
-            packed = np.zeros(len(col), dtype=np.int32)
-            sel = codes >= 0
-            packed[sel] = ((idx_u << 6) | rank_u)[codes[sel]]
-            return packed
+            return gather_with_null(
+                ((idx_u << 6) | rank_u).astype(np.int32), codes, 0
+            )
         # one-pass C kernel when available, identical numpy codes otherwise
         return hll.pack_codes(col.values, col.valid)
 
@@ -251,13 +253,36 @@ class _QuantileAnalyzerBase(ScanShareableAnalyzer):
 
     def device_batch(self, inputs: Dict[str, Any], xp) -> Any:
         x = xp.asarray(inputs[f"num:{self.column}"])
-        if xp is np and x.size == 0:
-            # numpy does not clamp gathers on size-0 arrays like XLA does;
-            # a 0-row batch contributes an explicit empty artifact
+        if xp is np:
+            # host fold fast path: compact the masked rows ONCE and sort
+            # only them (the generic path pays two float-mask temps plus a
+            # full-length sort with +inf fillers — ~2x the work); the
+            # decimated sample is identical because masked rows sort to
+            # the tail either way
+            valid = np.asarray(inputs[f"valid:{self.column}"])
+            where = inputs.get(where_key(getattr(self, "where", None)))
+            mask = np.asarray(valid, dtype=bool)
+            if where is not None and getattr(self, "where", None) is not None:
+                mask = mask & np.asarray(where, dtype=bool)
+            xm = np.asarray(x, dtype=np.float64)[mask]
+            n = xm.size
+            if n == 0:
+                return {
+                    "sample": np.zeros(0, dtype=np.float64),
+                    "n": np.zeros(1, dtype=np.float64),
+                    "level": np.zeros(1, dtype=np.int32),
+                }
+            xm.sort()
+            cap = self._sample_size()
+            level = max(0, int(np.ceil(np.log2(max(n, 1) / cap))))
+            stride = 1 << level
+            offset = stride // 2
+            kept = max(0, -(-(n - offset) // stride))
+            sample = xm[offset::stride][:kept]
             return {
-                "sample": np.zeros(0, dtype=np.float64),
-                "n": np.zeros(1, dtype=np.float64),
-                "level": np.zeros(1, dtype=np.int32),
+                "sample": sample,
+                "n": np.asarray([n], dtype=np.float64),
+                "level": np.asarray([level], dtype=np.int32),
             }
         m = (
             xp.asarray(inputs[f"valid:{self.column}"]).astype(x.dtype)

@@ -106,6 +106,25 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32),
         ]
         lib.hll_update_registers.restype = None
+        lib.masked_moments.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.masked_moments.restype = None
+        for name in ("bincount_i64", "bincount_i8"):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            fn.restype = None
         _LIB = lib
     except OSError:
         _LIB = None
@@ -132,6 +151,82 @@ def xxhash64_pack(values: np.ndarray, valid: np.ndarray) -> Optional[np.ndarray]
         packed.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     return packed
+
+
+def _u8_ptr(mask: Optional[np.ndarray]):
+    """Zero-copy uint8 pointer for a bool mask; None stays None (=all)."""
+    if mask is None:
+        return None
+    mask = np.ascontiguousarray(mask)
+    if mask.dtype == np.bool_:
+        mask = mask.view(np.uint8)
+    elif mask.dtype != np.uint8:
+        mask = mask.astype(np.uint8)
+    # keep the array alive through the call via the returned pair
+    return mask
+
+
+def masked_moments(
+    x: np.ndarray,
+    valid: Optional[np.ndarray],
+    where: Optional[np.ndarray],
+) -> Optional[np.ndarray]:
+    """One-pass fused moments for a (column, where) family:
+    [count, sum, min, max, m2, n_where]; None when native is unavailable
+    (caller falls back to numpy)."""
+    lib = _load()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float64)
+    valid = _u8_ptr(valid)
+    where = _u8_ptr(where)
+    out = np.empty(6, dtype=np.float64)
+    lib.masked_moments(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if valid is not None
+        else None,
+        where.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if where is not None
+        else None,
+        len(x),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    return out
+
+
+def bincount(
+    codes: np.ndarray,
+    nbins: int,
+    base: int = 0,
+    where: Optional[np.ndarray] = None,
+) -> Optional[np.ndarray]:
+    """counts[c + base] over in-range codes in one pass (no shifted-copy
+    temp); None when native is unavailable. Accepts int8/int64 codes
+    (other int dtypes are converted)."""
+    lib = _load()
+    if lib is None:
+        return None
+    codes = np.ascontiguousarray(codes)
+    if codes.dtype == np.int8:
+        fn = lib.bincount_i8
+    else:
+        if codes.dtype != np.int64:
+            codes = codes.astype(np.int64)
+        fn = lib.bincount_i64
+    where = _u8_ptr(where)
+    out = np.zeros(nbins, dtype=np.int64)
+    fn(
+        codes.ctypes.data,
+        where.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if where is not None
+        else None,
+        len(codes),
+        base,
+        nbins,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out
 
 
 def hll_update_registers(

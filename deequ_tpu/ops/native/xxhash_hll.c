@@ -14,6 +14,7 @@
  * RELATIVE_SD=0.05, 512 registers).
  */
 
+#include <math.h>
 #include <stdint.h>
 #include <stddef.h>
 
@@ -76,4 +77,73 @@ void hll_update_registers(const int32_t *packed, const uint8_t *where,
         int32_t rank = code & 0x3F;
         if (rank > regs[idx]) regs[idx] = rank;
     }
+}
+
+/* Dense-code bincount: out[codes[i] + base]++ for in-range codes, one
+ * pass with no shifted-copy temporary (numpy's bincount(codes + 1)
+ * allocates an n-row temp and re-casts). The host fold of the group-by
+ * count the reference runs as groupBy().agg(count)
+ * (reference: GroupingAnalyzers.scala:67-72). where==NULL means all
+ * rows; out must hold nbins slots (caller-zeroed). */
+void bincount_i64(const int64_t *codes, const uint8_t *where, int64_t n,
+                  int64_t base, int64_t nbins, int64_t *out) {
+    for (int64_t i = 0; i < n; i++) {
+        if (where && !where[i]) continue;
+        int64_t c = codes[i] + base;
+        if (c >= 0 && c < nbins) out[c]++;
+    }
+}
+
+/* Same for narrow codes (type-class codes, int8 wire formats). */
+void bincount_i8(const int8_t *codes, const uint8_t *where, int64_t n,
+                 int64_t base, int64_t nbins, int64_t *out) {
+    for (int64_t i = 0; i < n; i++) {
+        if (where && !where[i]) continue;
+        int64_t c = (int64_t)codes[i] + base;
+        if (c >= 0 && c < nbins) out[c]++;
+    }
+}
+
+/* Fused masked numeric moments: one data traversal feeds Mean, Sum,
+ * Minimum, Maximum, StandardDeviation and the count of a whole
+ * (column, where) family — the reductions the reference pushes into one
+ * Catalyst pass (reference: runners/AnalysisRunner.scala:279-326) need
+ * ~15 separate numpy passes host-side; this does two cache-friendly
+ * passes (sum/min/max, then centered m2 at the batch mean — the same
+ * centering the device kernel uses, StatefulStdDevPop semantics).
+ *
+ * valid/where may each be NULL (= all rows). Long-double accumulators
+ * keep sequential summation within 1e-15 of numpy's pairwise sums.
+ * out[6]: count, sum, min (+inf when empty), max (-inf), m2, n_where. */
+void masked_moments(const double *x, const uint8_t *valid,
+                    const uint8_t *where, int64_t n, double *out) {
+    long double sum = 0.0L;
+    int64_t count = 0, n_where = 0;
+    double mn = (double)INFINITY, mx = -(double)INFINITY;
+    for (int64_t i = 0; i < n; i++) {
+        if (where && !where[i]) continue;
+        n_where++;
+        if (valid && !valid[i]) continue;
+        double v = x[i];
+        sum += v;
+        count++;
+        if (v < mn) mn = v;
+        if (v > mx) mx = v;
+    }
+    double avg = count > 0 ? (double)(sum / count) : 0.0;
+    long double m2 = 0.0L;
+    if (count > 0) {
+        for (int64_t i = 0; i < n; i++) {
+            if (valid && !valid[i]) continue;
+            if (where && !where[i]) continue;
+            double d = x[i] - avg;
+            m2 += d * d;
+        }
+    }
+    out[0] = (double)count;
+    out[1] = (double)sum;
+    out[2] = mn;
+    out[3] = mx;
+    out[4] = (double)m2;
+    out[5] = where ? (double)n_where : (double)n;
 }

@@ -45,10 +45,34 @@ class TestTable:
         assert sizes == [4, 4, 2]
 
     def test_dict_encode(self):
+        # contract: codes index uniques row-by-row, nulls get -1; the
+        # dictionary ORDER is unspecified (arrow returns first-seen,
+        # the numpy fallback sorted — both valid)
         t = Table.from_pydict({"x": ["b", "a", None, "b"]})
         codes, uniques = t["x"].dict_encode()
-        assert list(uniques) == ["a", "b"]
-        assert list(codes) == [1, 0, -1, 1]
+        assert sorted(uniques) == ["a", "b"]
+        assert codes[2] == -1
+        decoded = [
+            uniques[c] if c >= 0 else None for c in codes
+        ]
+        assert decoded == ["b", "a", None, "b"]
+        # same value -> same code
+        assert codes[0] == codes[3]
+
+    def test_dict_encode_non_string_backing(self):
+        # a STRING-typed column whose object backing holds non-str values
+        # must stringify (the arrow fast path can't; the fallback does)
+        import numpy as np
+
+        from deequ_tpu.data.table import Column, ColumnType
+        from deequ_tpu.data.table import Table as T
+
+        vals = np.array([1, "a", 2, 1], dtype=object)
+        col = Column("x", ColumnType.STRING, vals, np.ones(4, dtype=np.bool_))
+        codes, uniques = T([col])["x"].dict_encode()
+        decoded = [uniques[c] for c in codes]
+        assert [str(d) for d in decoded] == ["1", "a", "2", "1"]
+        assert codes[0] == codes[3]
 
     def test_roundtrip_pandas(self):
         t = Table.from_pydict({"x": [1, 2, None], "y": ["a", None, "c"]})
