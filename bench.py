@@ -25,6 +25,12 @@ Knobs (env):
                      during the warmup run)
     BENCH_PARQUET   path for the stream-mode file (default /tmp/bench.parquet;
                      reused if it already has BENCH_ROWS rows)
+    BENCH_PLATFORM  force a jax platform ("cpu" | "tpu" | unset=default).
+                     The JAX_PLATFORMS env var does NOT override the axon
+                     TPU plugin on this box; this knob forces it in code.
+                     "cpu" is the fast-link stand-in for measuring the
+                     DEEQU_TPU_PLACEMENT=device path where "transfer" is
+                     a memcpy (a PCIe/ICI-class link proxy).
 """
 
 from __future__ import annotations
@@ -36,7 +42,11 @@ import time
 
 import numpy as np
 
-# Spark local-mode full-profile proxy, rows/s (justification: BENCH.md)
+# Spark local-mode full-profile proxy, rows/s (justification: BENCH.md).
+# Used as a FLOOR under the measured single-core pandas/numpy reference
+# implementation (measure_reference_profile_rows_per_sec): the
+# denominator is max(measured, proxy), i.e. always at least as generous
+# to Spark as the documented proxy.
 SPARK_LOCAL_PROFILE_ROWS_PER_SEC = 2.0e6
 # Spark local-mode fused scalar-scan proxy, rows/s (BENCH.md)
 SPARK_LOCAL_SCAN_ROWS_PER_SEC = 10.0e6
@@ -109,6 +119,81 @@ def run_scan(table):
     return results
 
 
+def measure_reference_profile_rows_per_sec(probe_rows: int = 2_000_000) -> float:
+    """Measured baseline denominator: a straightforward single-core
+    pandas/numpy implementation of the SAME 3-pass profile deequ runs
+    (pass 1: size/completeness/distinct/row-level regex DataType; pass 2:
+    min/max/mean/std/sum + 100 percentiles per numeric column incl. the
+    cast numeric-string column; pass 3: exact value counts for low-card
+    columns). This is what a competent engineer gets from the standard
+    Python stack on this box — a measured stand-in for "Spark local on
+    this machine", which a JVM + row-shuffle engine would not beat on a
+    single core. bench uses max(this, the documented 2.0M proxy) as the
+    denominator so the ratio is never inflated by a slow box."""
+    import re
+    import pandas as pd
+
+    df = build_table(probe_rows).to_pandas()
+    t0 = time.perf_counter()
+
+    # ---- pass 1: size, completeness, distinct, DataType inference ----
+    n = len(df)
+    _ = df.notna().mean()
+    for c in df.columns:
+        _ = df[c].nunique()
+    frac = re.compile(r"^(-|\+)? ?\d*\.\d*$")
+    integ = re.compile(r"^(-|\+)? ?\d*$")
+    boolean = re.compile(r"^(true|false)$")
+    type_counts = {}
+    for c in ("category", "code"):
+        s = df[c].dropna().astype(str)
+        type_counts[c] = (
+            s.str.fullmatch(frac).sum(),
+            s.str.fullmatch(integ).sum(),
+            s.str.fullmatch(boolean).sum(),
+        )
+
+    # ---- pass 2: numeric stats + percentiles (code casts to numeric) ----
+    numeric = {
+        "price": df["price"],
+        "discount": df["discount"],
+        "qty": df["qty"],
+        "code": pd.to_numeric(df["code"], errors="coerce"),
+    }
+    qs = np.arange(1, 101) / 100.0
+    for c, s in numeric.items():
+        _ = (s.min(), s.max(), s.mean(), s.std(), s.sum())
+        vals = s.dropna().to_numpy(dtype=np.float64)
+        if len(vals):
+            _ = np.quantile(vals, qs)
+
+    # ---- pass 3: exact histograms for low-cardinality columns ----
+    for c in ("category", "flag"):
+        _ = df[c].value_counts(dropna=False)
+
+    elapsed = max(time.perf_counter() - t0, 1e-9)
+    return probe_rows / elapsed
+
+
+def _measure_baseline_subprocess() -> float:
+    """Run the pandas reference profile in a SUBPROCESS so its transient
+    working set never pollutes the bench process's peak-RSS report and
+    its wall time never mixes into the engine's timings."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--measure-baseline"],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        return float(out.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001 - fall back to the in-process probe
+        return measure_reference_profile_rows_per_sec()
+
+
 def write_parquet(n_rows: int, path: str, chunk: int = 2_000_000) -> None:
     """Stream-generate the bench table to disk in chunks (bounded memory),
     so stream mode can exceed host RAM."""
@@ -141,6 +226,11 @@ def write_parquet(n_rows: int, path: str, chunk: int = 2_000_000) -> None:
 
 
 def main() -> None:
+    platform = os.environ.get("BENCH_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
     n_rows = int(os.environ.get("BENCH_ROWS", "10000000"))
     mode = os.environ.get("BENCH_MODE", "profiler")
     reps = max(1, int(os.environ.get("BENCH_TIMED", "2")))
@@ -162,11 +252,28 @@ def main() -> None:
     gen_s = time.perf_counter() - t_gen
 
     run = run_scan if mode == "scan" else run_profiler
-    baseline = (
-        SPARK_LOCAL_SCAN_ROWS_PER_SEC
-        if mode == "scan"
-        else SPARK_LOCAL_PROFILE_ROWS_PER_SEC
-    )
+    if mode == "scan":
+        baseline = SPARK_LOCAL_SCAN_ROWS_PER_SEC
+        baseline_note = "proxy"
+    else:
+        # measured denominator (BENCH_BASELINE=proxy skips; a float
+        # overrides): single-core pandas/numpy equivalent profile,
+        # floored at the documented proxy so a slow box can't inflate
+        # the ratio
+        baseline_env = os.environ.get("BENCH_BASELINE", "measured")
+        if baseline_env == "proxy":
+            baseline = SPARK_LOCAL_PROFILE_ROWS_PER_SEC
+            baseline_note = "proxy"
+        elif baseline_env == "measured":
+            measured = _measure_baseline_subprocess()
+            baseline = max(measured, SPARK_LOCAL_PROFILE_ROWS_PER_SEC)
+            baseline_note = (
+                f"max(measured pandas {measured / 1e6:.2f}M rows/s, "
+                f"{SPARK_LOCAL_PROFILE_ROWS_PER_SEC / 1e6:.1f}M proxy)"
+            )
+        else:
+            baseline = float(baseline_env)
+            baseline_note = "override"
 
     # warmup: compiles every (analyzer-set, padded-shape) program
     t_warm = time.perf_counter()
@@ -181,9 +288,13 @@ def main() -> None:
     best = min(times)
     rows_per_sec = n_rows / best
 
+    import resource
+
+    peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
     print(
         f"# bench: mode={mode} rows={n_rows} gen={gen_s:.1f}s "
-        f"warmup={warm_s:.1f}s timed={best:.2f}s",
+        f"warmup={warm_s:.1f}s timed={best:.2f}s peak_rss={peak_rss_mb:.0f}MB "
+        f"baseline={baseline / 1e6:.2f}M rows/s [{baseline_note}]",
         file=sys.stderr,
     )
     print(
@@ -199,4 +310,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--measure-baseline" in sys.argv:
+        print(measure_reference_profile_rows_per_sec())
+    else:
+        main()

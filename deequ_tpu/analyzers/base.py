@@ -237,16 +237,23 @@ class Analyzer:
 class InputSpec:
     """One named host-prepped array. Keys are globally deduplicated across
     all analyzers in a pass: two analyzers over the same column share one
-    device array (the offset-bookkeeping analogue, but by name)."""
+    device array (the offset-bookkeeping analogue, but by name).
+
+    `columns` names the table columns the build reads — the pass unions
+    them for column pruning, so a streaming source only decodes what the
+    pass actually consumes (the Parquet analogue of Spark's column
+    pruning). None = unknown reads; pruning is disabled for the pass."""
 
     key: str
     build: Callable[[Table], np.ndarray]
+    columns: Optional[Tuple[str, ...]] = None
 
 
 def col_values_spec(column: str) -> InputSpec:
     return InputSpec(
         key=f"num:{column}",
         build=lambda t: t.column(column).numeric_values()[0],
+        columns=(column,),
     )
 
 
@@ -254,6 +261,7 @@ def col_valid_spec(column: str) -> InputSpec:
     return InputSpec(
         key=f"valid:{column}",
         build=lambda t: t.column(column).valid,
+        columns=(column,),
     )
 
 
@@ -271,11 +279,13 @@ def where_spec(where: Optional[str]) -> InputSpec:
         return InputSpec(
             key=where_key(None),
             build=lambda t: np.ones(t.num_rows, dtype=np.bool_),
+            columns=(),
         )
     pred = Predicate(where)
     return InputSpec(
         key=where_key(where),
         build=lambda t: pred.eval_mask(t),
+        columns=tuple(sorted(set(pred.referenced_columns()))),
     )
 
 

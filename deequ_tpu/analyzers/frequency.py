@@ -175,6 +175,8 @@ def compute_frequencies(
 
     runtime.record_group_pass(",".join(grouping_columns))
 
+    if hasattr(data, "with_columns"):
+        data = data.with_columns(list(grouping_columns))
     if getattr(data, "is_streaming", False):
         state: Optional[FrequenciesAndNumRows] = None
         for batch in data.batches(getattr(data, "batch_rows", 1 << 22)):

@@ -88,6 +88,8 @@ class Histogram(Analyzer):
         from deequ_tpu.ops import runtime
 
         runtime.record_group_pass(f"histogram:{self.column}")
+        if hasattr(table, "with_columns"):
+            table = table.with_columns([self.column])
         if getattr(table, "is_streaming", False):
             state: Optional[FrequenciesAndNumRows] = None
             for batch in table.batches(getattr(table, "batch_rows", 1 << 22)):

@@ -222,7 +222,11 @@ def _pred_spec(predicate: str) -> InputSpec:
     from deequ_tpu.data.expr import Predicate
 
     pred = Predicate(predicate)
-    return InputSpec(key=f"pred:{predicate}", build=lambda t: pred.eval_mask(t))
+    return InputSpec(
+        key=f"pred:{predicate}",
+        build=lambda t: pred.eval_mask(t),
+        columns=tuple(sorted(set(pred.referenced_columns()))),
+    )
 
 
 def _pred_nonnull_spec(predicate: str) -> InputSpec:
@@ -234,7 +238,11 @@ def _pred_nonnull_spec(predicate: str) -> InputSpec:
         _, null, _ = pred.eval(t)
         return ~null
 
-    return InputSpec(key=f"prednn:{predicate}", build=build)
+    return InputSpec(
+        key=f"prednn:{predicate}",
+        build=build,
+        columns=tuple(sorted(set(pred.referenced_columns()))),
+    )
 
 
 @dataclass(frozen=True)
@@ -319,7 +327,7 @@ def _match_spec(column: str, pattern: str) -> InputSpec:
         codes, uniques = col.dict_encode()
         return gather_with_null(match_pattern(uniques, pattern), codes, False)
 
-    return InputSpec(key=f"match:{column}:{pattern}", build=build)
+    return InputSpec(key=f"match:{column}:{pattern}", build=build, columns=(column,))
 
 
 @dataclass(frozen=True)
@@ -791,7 +799,7 @@ def _dtclass_spec(column: str) -> InputSpec:
         }[col.ctype]
         return np.where(col.valid, np.int8(static), np.int8(_CODE_NULL))
 
-    return InputSpec(key=f"dtclass:{column}", build=build)
+    return InputSpec(key=f"dtclass:{column}", build=build, columns=(column,))
 
 
 @dataclass(frozen=True)

@@ -89,7 +89,7 @@ def _hll_spec(column: str) -> InputSpec:
         # one-pass C kernel when available, identical numpy codes otherwise
         return hll.pack_codes(col.values, col.valid)
 
-    return InputSpec(key=f"hll:{column}", build=build)
+    return InputSpec(key=f"hll:{column}", build=build, columns=(column,))
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,38 @@
+"""Text-file output with overwrite guard + atomic replace.
+
+The role of the reference's DfsUtils.writeToTextFileOnDfs
+(reference: io/DfsUtils.scala:24-84) for the builders' save-JSON-to-path
+options: refuse to clobber an existing file unless overwrite was
+requested, and never leave a half-written file behind (tmp + rename, the
+same atomicity contract as the FS metrics repository,
+reference: repository/fs/FileSystemMetricsRepository.scala:167-195).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def write_text_output(path: str, text: str, overwrite: bool = False) -> None:
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(
+            f"File {path} already exists and overwrite disabled"
+        )
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(text)
+            if not text.endswith("\n"):
+                f.write("\n")
+        # mkstemp creates 0600; give the artifact the normal
+        # umask-respecting mode a plain open() would have produced
+        umask = os.umask(0)
+        os.umask(umask)
+        os.chmod(tmp, 0o666 & ~umask)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise

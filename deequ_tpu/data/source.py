@@ -29,6 +29,8 @@ _SENTINEL = object()
 def _arrow_ctype(t) -> ColumnType:
     import pyarrow as pa
 
+    if pa.types.is_dictionary(t):
+        t = t.value_type
     if pa.types.is_boolean(t):
         return ColumnType.BOOLEAN
     if pa.types.is_integer(t):
@@ -189,17 +191,35 @@ class ParquetSource(DataSource):
     def num_rows(self) -> int:
         return self._num_rows
 
+    def with_columns(self, names) -> "ParquetSource":
+        """Column-pruned view: the fused pass calls this with the union
+        of its input specs' columns so only consumed columns are decoded
+        (Spark's column pruning, the dominant stream-mode cost)."""
+        keep = [n for n, _ in self._schema_cache if n in set(names)]
+        if keep == [n for n, _ in self._schema_cache] or not keep:
+            return self
+        return ParquetSource(self.path, columns=keep, batch_rows=self.batch_rows)
+
     def _iter_tables(self, batch_size: int) -> Iterator[Table]:
         import pyarrow.parquet as pq
 
         size = min(batch_size, self.batch_rows)
-        # read row group by row group: this pyarrow's iter_batches /
+        # Read row group by row group: this pyarrow's iter_batches /
         # dataset scanner retain every decoded batch in the pool for the
         # reader's lifetime (measured: RSS grows linearly with batches
         # consumed), while read_row_group frees cleanly. Memory bound is
         # O(row group + batch), so files written with sane group sizes
         # stream at constant memory.
-        with pq.ParquetFile(self.path) as pf:
+        # String columns decode as DictionaryArray (read_dictionary):
+        # parquet pages are dictionary-encoded on disk, so this skips
+        # materializing per-row strings AND hands dict_encode its codes
+        # for free (Table.from_arrow stores them directly).
+        str_cols = [
+            n for n, t in self._schema_cache if t == ColumnType.STRING
+        ]
+        with pq.ParquetFile(
+            self.path, read_dictionary=str_cols or None
+        ) as pf:
             for g in range(pf.metadata.num_row_groups):
                 group = pf.read_row_group(g, columns=self.columns)
                 for start in range(0, group.num_rows, size):
@@ -223,11 +243,23 @@ class MappedSource(DataSource):
     ):
         self.base = base
         self.fn = fn
-        overrides = dict(schema_overrides or [])
+        self._overrides = list(schema_overrides or [])
+        overrides = dict(self._overrides)
         self._schema_cache = [
             (name, overrides.get(name, ctype)) for name, ctype in base.schema
         ]
         self.batch_rows = getattr(base, "batch_rows", DataSource.batch_rows)
+
+    def with_columns(self, names) -> "MappedSource":
+        base_wc = getattr(self.base, "with_columns", None)
+        if base_wc is None:
+            return self
+        kept = set(names)
+        return MappedSource(
+            base_wc(names),
+            self.fn,
+            [(n, t) for n, t in self._overrides if n in kept],
+        )
 
     def _schema(self) -> List[Tuple[str, ColumnType]]:
         return self._schema_cache

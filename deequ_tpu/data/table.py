@@ -420,6 +420,14 @@ class Table:
             arr = arrow_table.column(name).combine_chunks()
             if isinstance(arr, pa.ChunkedArray):
                 arr = arr.chunk(0) if arr.num_chunks else pa.array([], arr.type)
+            if pa.types.is_dictionary(arr.type) and not (
+                pa.types.is_string(arr.type.value_type)
+                or pa.types.is_large_string(arr.type.value_type)
+            ):
+                # only string dictionaries have a first-class code path;
+                # others decode to their value type so the column's ctype
+                # matches what _arrow_ctype reports for the schema
+                arr = arr.dictionary_decode()
             valid = np.asarray(arr.is_valid())
             t = arr.type
             if pa.types.is_boolean(t):
@@ -444,6 +452,33 @@ class Table:
                 cols.append(
                     Column(name, ColumnType.TIMESTAMP, vals.astype("datetime64[us]"), valid)
                 )
+            elif pa.types.is_dictionary(t) and (
+                pa.types.is_string(t.value_type)
+                or pa.types.is_large_string(t.value_type)
+            ):
+                # dictionary-decoded string column (ParquetSource reads
+                # string columns this way): the codes ARE the dict_encode
+                # result — no per-row string materialization, no re-encode.
+                # `values` stays lazy; only consumers that truly need
+                # per-row python strings pay the gather.
+                codes = (
+                    arr.indices.fill_null(-1)
+                    .to_numpy(zero_copy_only=False)
+                    .astype(np.int64)
+                )
+                uniques = arr.dictionary.to_numpy(zero_copy_only=False)
+                if uniques.dtype != object:
+                    uniques = uniques.astype(object)
+                col = Column(
+                    name,
+                    ColumnType.STRING,
+                    lambda codes=codes, uniques=uniques: gather_with_null(
+                        uniques, codes, ""
+                    ),
+                    valid,
+                )
+                col._cache["dict_encode"] = (codes, uniques)
+                cols.append(col)
             elif pa.types.is_string(t) or pa.types.is_large_string(t):
                 vals = arr.to_numpy(zero_copy_only=False)
                 if vals.dtype != object:

@@ -33,6 +33,7 @@ from deequ_tpu.ops.fused import (
     _pad_size,
     fold_host_batch,
     materialize_host_results,
+    prune_table_columns,
 )
 
 DATA_AXIS = "data"
@@ -171,6 +172,7 @@ class DistributedScanPass:
                 merge_idx.append(i)
                 device_keys.update(s.key for s in analyzer_specs)
 
+        table = prune_table_columns(table, specs)
         n_devices = self.mesh.shape[self.axis_name]
         global_batch = self.batch_size_per_device * n_devices
         dtype = runtime.compute_dtype()

@@ -219,6 +219,8 @@ def _cast_numeric_string_columns(
     def cast_batch(batch: Table) -> Table:
         out = batch
         for name in to_cast:
+            if not batch.has_column(name):
+                continue  # column-pruned batch: nothing to cast
             col = batch.column(name)
             values, valid = col.numeric_values()
             out = out.with_column(Column(name, ColumnType.DOUBLE, values, valid))
@@ -301,6 +303,8 @@ def _compute_histograms(
     from deequ_tpu.ops import runtime
 
     runtime.record_group_pass("profiler-histograms:" + ",".join(target_columns))
+    if hasattr(data, "with_columns"):
+        data = data.with_columns(list(target_columns))
 
     totals: Dict[str, Dict[str, int]] = {name: {} for name in target_columns}
     null_counts: Dict[str, int] = {name: 0 for name in target_columns}

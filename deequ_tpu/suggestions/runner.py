@@ -57,6 +57,10 @@ class ConstraintSuggestionRunBuilder:
         self._reuse_key = None
         self._fail_if_results_missing = False
         self._save_key = None
+        self._save_column_profiles_json_path: Optional[str] = None
+        self._save_constraint_suggestions_json_path: Optional[str] = None
+        self._save_evaluation_results_json_path: Optional[str] = None
+        self._overwrite_output_files = False
 
     def add_constraint_rule(self, rule: ConstraintRule) -> "ConstraintSuggestionRunBuilder":
         self._rules.append(rule)
@@ -107,6 +111,32 @@ class ConstraintSuggestionRunBuilder:
         self._save_key = key
         return self
 
+    def save_column_profiles_json_to_path(
+        self, path: str
+    ) -> "ConstraintSuggestionRunBuilder":
+        """reference: ConstraintSuggestionRunBuilder.scala:243-249."""
+        self._save_column_profiles_json_path = path
+        return self
+
+    def save_constraint_suggestions_json_to_path(
+        self, path: str
+    ) -> "ConstraintSuggestionRunBuilder":
+        """reference: ConstraintSuggestionRunBuilder.scala:256-262."""
+        self._save_constraint_suggestions_json_path = path
+        return self
+
+    def save_evaluation_results_json_to_path(
+        self, path: str
+    ) -> "ConstraintSuggestionRunBuilder":
+        """reference: ConstraintSuggestionRunBuilder.scala:269-275."""
+        self._save_evaluation_results_json_path = path
+        return self
+
+    def overwrite_output_files(self, value: bool) -> "ConstraintSuggestionRunBuilder":
+        """reference: ConstraintSuggestionRunBuilder.scala:283-286."""
+        self._overwrite_output_files = value
+        return self
+
     def run(self) -> ConstraintSuggestionResult:
         """reference: ConstraintSuggestionRunner.scala:62-125."""
         # optional train/test split
@@ -151,6 +181,32 @@ class ConstraintSuggestionRunBuilder:
                     check = check.add_constraint(suggestion.constraint)
             verification_result = VerificationSuite.do_verification_run(test, [check])
 
-        return ConstraintSuggestionResult(
+        result = ConstraintSuggestionResult(
             profiles.profiles, profiles.num_records, suggestions, verification_result
         )
+
+        # JSON file outputs (reference: ConstraintSuggestionRunner.scala:220-281)
+        from deequ_tpu.core.fileio import write_text_output
+        from deequ_tpu.suggestions.suggestion import evaluation_results_to_json
+
+        if self._save_column_profiles_json_path is not None:
+            write_text_output(
+                self._save_column_profiles_json_path,
+                profiles.to_json(),
+                self._overwrite_output_files,
+            )
+        if self._save_constraint_suggestions_json_path is not None:
+            write_text_output(
+                self._save_constraint_suggestions_json_path,
+                result.suggestions_as_json(),
+                self._overwrite_output_files,
+            )
+        if self._save_evaluation_results_json_path is not None:
+            write_text_output(
+                self._save_evaluation_results_json_path,
+                evaluation_results_to_json(
+                    result.all_suggestions(), verification_result
+                ),
+                self._overwrite_output_files,
+            )
+        return result

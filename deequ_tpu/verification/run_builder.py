@@ -44,6 +44,9 @@ class VerificationRunBuilder:
         self._save_states_with: Optional["StatePersister"] = None
         self._engine: str = "auto"
         self._mesh = None
+        self._save_check_results_json_path: Optional[str] = None
+        self._save_success_metrics_json_path: Optional[str] = None
+        self._overwrite_output_files = False
 
     def with_engine(self, engine: str, mesh=None) -> "VerificationRunBuilder":
         """"auto" (mesh when >1 device), "single", or "distributed"."""
@@ -118,8 +121,26 @@ class VerificationRunBuilder:
         self._checks.append(check)
         return self
 
+    def save_check_results_json_to_path(self, path: str) -> "VerificationRunBuilder":
+        """reference: VerificationRunBuilder.scala:226-231."""
+        self._save_check_results_json_path = path
+        return self
+
+    def save_success_metrics_json_to_path(self, path: str) -> "VerificationRunBuilder":
+        """reference: VerificationRunBuilder.scala:239-244."""
+        self._save_success_metrics_json_path = path
+        return self
+
+    def overwrite_output_files(self, value: bool) -> "VerificationRunBuilder":
+        """Whether previous files with identical names should be
+        overwritten (reference: VerificationRunBuilder.scala:253-256 —
+        where the reference's self-assignment bug makes the option a
+        no-op; here it works)."""
+        self._overwrite_output_files = value
+        return self
+
     def run(self) -> VerificationResult:
-        return VerificationSuite.do_verification_run(
+        result = VerificationSuite.do_verification_run(
             self._data,
             self._checks,
             self._required_analyzers,
@@ -132,3 +153,19 @@ class VerificationRunBuilder:
             engine=self._engine,
             mesh=self._mesh,
         )
+        # JSON file outputs (reference: VerificationSuite.scala:146-172)
+        from deequ_tpu.core.fileio import write_text_output
+
+        if self._save_check_results_json_path is not None:
+            write_text_output(
+                self._save_check_results_json_path,
+                result.check_results_as_json(),
+                self._overwrite_output_files,
+            )
+        if self._save_success_metrics_json_path is not None:
+            write_text_output(
+                self._save_success_metrics_json_path,
+                result.success_metrics_as_json(),
+                self._overwrite_output_files,
+            )
+        return result
