@@ -80,7 +80,10 @@ class FrequenciesAndNumRows(State):
     def num_groups(self) -> int:
         return len(self.counts)
 
-    def merge(self, other: "FrequenciesAndNumRows") -> "FrequenciesAndNumRows":
+    def merge(self, other) -> "FrequenciesAndNumRows":
+        if getattr(other, "is_spilled", False):
+            # spilled ⊕ in-memory commutes; the spilled side knows how
+            return other.merge(self)
         other_cols = other.key_columns
         if self.columns != other.columns:
             # align by column name (the columnar analogue of the
@@ -93,38 +96,25 @@ class FrequenciesAndNumRows(State):
             other_cols = [
                 other.key_columns[other.columns.index(c)] for c in self.columns
             ]
-        # C-hash group-by over the concatenated key columns — the
-        # vectorized form of the reference's outer join + count sum
-        # (GroupingAnalyzers.scala:128-148); no Python loop over groups
-        import pandas as pd
-
-        frame = {
-            f"k{j}": np.concatenate([self.key_columns[j], other_cols[j]])
-            for j in range(len(self.columns))
-        }
-        frame["__count"] = np.concatenate([self.counts, other.counts])
-        grouped = (
-            pd.DataFrame(frame)
-            .groupby(
-                [f"k{j}" for j in range(len(self.columns))],
-                sort=False,
-                dropna=False,  # NaN/None group keys are real groups
-            )["__count"]
-            .sum()
-        )
-        index = grouped.index
-        if len(self.columns) == 1:
-            key_columns = [index.to_numpy(dtype=object)]
-        else:
-            key_columns = [
-                index.get_level_values(j).to_numpy(dtype=object)
+        key_columns, counts = _group_sum(
+            [
+                np.concatenate([self.key_columns[j], other_cols[j]])
                 for j in range(len(self.columns))
-            ]
+            ],
+            np.concatenate([self.counts, other.counts]),
+        )
         return FrequenciesAndNumRows(
             list(self.columns),
             key_columns,
-            grouped.to_numpy(dtype=np.int64),
+            counts,
             self.num_rows + other.num_rows,
+        )
+
+    def compacted(self) -> "FrequenciesAndNumRows":
+        """Re-group duplicate key rows (spill-partition compaction)."""
+        key_columns, counts = _group_sum(self.key_columns, self.counts)
+        return FrequenciesAndNumRows(
+            list(self.columns), key_columns, counts, self.num_rows
         )
 
     def __eq__(self, other) -> bool:
@@ -142,6 +132,37 @@ class FrequenciesAndNumRows(State):
             f"FrequenciesAndNumRows({self.columns}, groups={self.num_groups}, "
             f"num_rows={self.num_rows})"
         )
+
+
+def _group_sum(
+    key_columns: List[np.ndarray], counts: np.ndarray
+) -> Tuple[List[np.ndarray], np.ndarray]:
+    """C-hash group-by summing counts over identical key rows — the
+    vectorized form of the reference's null-safe outer join + count sum
+    (GroupingAnalyzers.scala:128-148); no Python loop over groups."""
+    import pandas as pd
+
+    n_cols = len(key_columns)
+    frame = {f"k{j}": key_columns[j] for j in range(n_cols)}
+    frame["__count"] = counts
+    grouped = (
+        pd.DataFrame(frame)
+        .groupby(
+            [f"k{j}" for j in range(n_cols)],
+            sort=False,
+            dropna=False,  # NaN/None group keys are real groups
+        )["__count"]
+        .sum()
+    )
+    index = grouped.index
+    if n_cols == 1:
+        out_keys = [index.to_numpy(dtype=object)]
+    else:
+        out_keys = [
+            index.get_level_values(j).to_numpy(dtype=object)
+            for j in range(n_cols)
+        ]
+    return out_keys, grouped.to_numpy(dtype=np.int64)
 
 
 def _column_key_values(col) -> Tuple[np.ndarray, np.ndarray]:
@@ -178,14 +199,15 @@ def compute_frequencies(
     if hasattr(data, "with_columns"):
         data = data.with_columns(list(grouping_columns))
     if getattr(data, "is_streaming", False):
-        state: Optional[FrequenciesAndNumRows] = None
+        # bounded-memory fold: in-RAM merges below the group cap, hash-
+        # partitioned disk spill above it (the MEMORY_AND_DISK escape
+        # hatch, reference: AnalysisRunner.scala:75,479-483)
+        from deequ_tpu.analyzers.freq_spill import GroupCountAccumulator
+
+        acc = GroupCountAccumulator(grouping_columns)
         for batch in data.batches(getattr(data, "batch_rows", 1 << 22)):
-            partial = _frequencies_of_batch(batch, grouping_columns, mesh)
-            state = partial if state is None else state.merge(partial)
-        if state is None:
-            state = FrequenciesAndNumRows(
-                list(grouping_columns), [], np.array([], dtype=np.int64), 0
-            )
+            acc.add(_frequencies_of_batch(batch, grouping_columns, mesh))
+        state = acc.finalize()
         if num_rows is not None:
             state.num_rows = num_rows
         return state
@@ -461,6 +483,42 @@ class MutualInformation(FrequencyBasedAnalyzer):
         # state columns may be sorted differently than self.columns
         ia = state.columns.index(self.columns[0])
         ib = state.columns.index(self.columns[1])
+
+        if getattr(state, "is_spilled", False):
+            # two streamed passes over the partitions: marginal counts
+            # (memory O(|A| + |B|), typically << O(|A×B|) joint groups),
+            # then the joint sum
+            marg_a: Dict[str, float] = {}
+            marg_b: Dict[str, float] = {}
+            for part in state.partitions():
+                counts = part.counts.astype(np.float64)
+                for keys, marg in (
+                    (part.key_columns[ia], marg_a),
+                    (part.key_columns[ib], marg_b),
+                ):
+                    uniq, inv = np.unique(keys.astype(str), return_inverse=True)
+                    sums = np.bincount(inv, weights=counts)
+                    for u, s in zip(uniq, sums):
+                        marg[u] = marg.get(u, 0.0) + s
+            value = 0.0
+            for part in state.partitions():
+                counts = part.counts.astype(np.float64)
+                pxy = counts / total
+                # dict lookups per UNIQUE key, gathers per row (inverse
+                # codes) — same vectorization as the in-memory branch
+                ua, inv_a = np.unique(
+                    part.key_columns[ia].astype(str), return_inverse=True
+                )
+                ub, inv_b = np.unique(
+                    part.key_columns[ib].astype(str), return_inverse=True
+                )
+                px = np.array([marg_a[u] for u in ua])[inv_a] / total
+                py = np.array([marg_b[u] for u in ub])[inv_b] / total
+                value += float(np.sum(pxy * np.log(pxy / (px * py))))
+            return DoubleMetric(
+                self.entity, self.name, self.instance, Success(value)
+            )
+
         keys_a = state.key_columns[ia]
         keys_b = state.key_columns[ib]
         counts = state.counts.astype(np.float64)

@@ -91,11 +91,17 @@ class Histogram(Analyzer):
         if hasattr(table, "with_columns"):
             table = table.with_columns([self.column])
         if getattr(table, "is_streaming", False):
-            state: Optional[FrequenciesAndNumRows] = None
+            # bounded-memory fold with the same spill escape hatch as
+            # compute_frequencies: a high-cardinality histogram column
+            # must not hold every group in RAM
+            from deequ_tpu.analyzers.freq_spill import GroupCountAccumulator
+
+            acc = GroupCountAccumulator([self.column])
+            saw_batch = False
             for batch in table.batches(getattr(table, "batch_rows", 1 << 22)):
-                partial = self._state_of_batch(batch)
-                state = partial if state is None else state.merge(partial)
-            return state
+                saw_batch = True
+                acc.add(self._state_of_batch(batch))
+            return acc.finalize() if saw_batch else None
         return self._state_of_batch(table)
 
     def _state_of_batch(self, table: Table) -> FrequenciesAndNumRows:
@@ -152,11 +158,20 @@ class Histogram(Analyzer):
 
         def build() -> Distribution:
             bin_count = state.num_groups
-            order = np.argsort(state.counts, kind="stable")[::-1][: self.max_detail_bins]
+            if getattr(state, "is_spilled", False):
+                # exact global top-N from per-partition top-Ns (each
+                # partition holds its keys' full counts)
+                top_keys, top_counts = state.top_n(self.max_detail_bins)
+                keys_arr, counts_arr = top_keys[0], top_counts
+            else:
+                order = np.argsort(state.counts, kind="stable")[::-1][
+                    : self.max_detail_bins
+                ]
+                keys_arr = state.key_columns[0][order]
+                counts_arr = state.counts[order]
             details = {}
-            for i in order:
-                value = state.key_columns[0][i]
-                absolute = int(state.counts[i])
+            for value, absolute in zip(keys_arr, counts_arr):
+                absolute = int(absolute)
                 details[value] = DistributionValue(
                     absolute, absolute / state.num_rows
                 )

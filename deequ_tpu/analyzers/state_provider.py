@@ -147,7 +147,6 @@ class FileSystemStateProvider(StateLoader, StatePersister):
         directory = os.path.dirname(os.path.abspath(paths["-frequencies.pqt"])) or "."
         os.makedirs(directory, exist_ok=True)
 
-        columns = _frequencies_to_columns(state)
         # write siblings first, parquet last via tmp+rename: load() keys on
         # the .pqt, so a crash mid-persist leaves a state that reads as
         # absent, never corrupt
@@ -156,7 +155,30 @@ class FileSystemStateProvider(StateLoader, StatePersister):
         with open(paths["-columns.txt"], "w", encoding="utf-8") as f:
             f.write("\n".join(state.columns))
         tmp = paths["-frequencies.pqt"] + ".tmp"
-        pq.write_table(pa.table(columns), tmp)
+        if getattr(state, "is_spilled", False):
+            # disk-spilled state streams partition by partition into the
+            # same Parquet layout (one row group per partition) — persist
+            # never materializes the full key set
+            writer = None
+            for part in state.partitions():
+                at = pa.table(_frequencies_to_columns(part))
+                if writer is None:
+                    writer = pq.ParquetWriter(tmp, at.schema)
+                writer.write_table(at)
+            if writer is None:
+                pq.write_table(
+                    pa.table(
+                        {
+                            **{name: [] for name in state.columns},
+                            COUNT_COL: np.array([], dtype=np.int64),
+                        }
+                    ),
+                    tmp,
+                )
+            else:
+                writer.close()
+        else:
+            pq.write_table(pa.table(_frequencies_to_columns(state)), tmp)
         os.replace(tmp, paths["-frequencies.pqt"])
 
     def _load_frequencies(self, identifier: str):

@@ -48,6 +48,31 @@ def run_shared_freq_agg(
 ) -> List[Any]:
     """One fused aggregation pass -> one metric per analyzer (in order)."""
     runtime.record_pass("freq-agg:" + ",".join(a.name for a in analyzers))
+    if getattr(state, "is_spilled", False):
+        # disk-spilled frequencies: every freq_reduce is a sum over
+        # groups, so the aggregation streams partition by partition and
+        # sums the (scalar) aggregate leaves — exact, never materializing
+        # the full counts array
+        totals: List[Any] = [None] * len(analyzers)
+        for part in state.partitions():
+            part_counts = part.counts.astype(np.float64)
+            for i, analyzer in enumerate(analyzers):
+                agg = analyzer.freq_reduce(part_counts, float(state.num_rows), np)
+                totals[i] = (
+                    agg
+                    if totals[i] is None
+                    else {k: totals[i][k] + agg[k] for k in agg}
+                )
+        empty = np.zeros(0, dtype=np.float64)
+        aggs = [
+            t
+            if t is not None
+            else a.freq_reduce(empty, float(state.num_rows), np)
+            for a, t in zip(analyzers, totals)
+        ]
+        return [
+            a.metric_from_freq_agg(agg, state) for a, agg in zip(analyzers, aggs)
+        ]
     counts = state.counts.astype(np.float64)
 
     if len(counts) >= _DEVICE_THRESHOLD:

@@ -1,0 +1,241 @@
+"""Bounded-memory high-cardinality grouping: the hash-partitioned disk
+spill behind the frequency family (the engine-level MEMORY_AND_DISK
+escape hatch, reference: runners/AnalysisRunner.scala:75,479-483).
+
+Every test forces a tiny in-memory group cap so the spill machinery is
+exercised at test scale, and asserts metric equality against the plain
+in-memory path — the spill must be an execution detail, never a
+semantics change."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import (
+    CountDistinct,
+    Distinctness,
+    Entropy,
+    Histogram,
+    MutualInformation,
+    Uniqueness,
+    UniqueValueRatio,
+)
+from deequ_tpu.analyzers.freq_spill import GroupCountAccumulator, SpilledFrequencies
+from deequ_tpu.analyzers.frequency import FrequenciesAndNumRows, compute_frequencies
+from deequ_tpu.data.source import ParquetSource
+from deequ_tpu.data.table import Table
+from deequ_tpu.runners.analysis_runner import AnalysisRunner
+
+N_ROWS = 120_000
+
+
+@pytest.fixture(autouse=True)
+def tiny_group_cap(monkeypatch):
+    # spill after 10k in-RAM groups: the ~unique id column (120k groups)
+    # must go to disk
+    monkeypatch.setenv("DEEQU_TPU_MAX_GROUPS_IN_MEMORY", "10000")
+
+
+@pytest.fixture(scope="module")
+def high_card_parquet(tmp_path_factory):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(11)
+    ids = np.array([f"id_{i:08d}" for i in range(N_ROWS)], dtype=object)
+    rng.shuffle(ids)
+    ids[::1000] = "dup_key"  # a few repeats so uniqueness < 1
+    cat = np.array(["x", "y", "z"], dtype=object)[rng.integers(0, 3, N_ROWS)]
+    path = tmp_path_factory.mktemp("spill") / "high_card.parquet"
+    pq.write_table(
+        pa.table({"id": pa.array(list(ids)), "cat": pa.array(list(cat))}),
+        str(path),
+        row_group_size=20_000,
+    )
+    return str(path)
+
+
+GROUPING = [
+    Uniqueness(("id",)),
+    Distinctness(("id",)),
+    UniqueValueRatio(("id",)),
+    CountDistinct(("id",)),
+    Entropy("id"),
+]
+
+
+def test_streaming_high_card_spills_and_matches_in_memory(high_card_parquet):
+    source = ParquetSource(high_card_parquet, batch_rows=1 << 14)
+    ctx_stream = AnalysisRunner.do_analysis_run(source, GROUPING, engine="single")
+    ctx_mem = AnalysisRunner.do_analysis_run(
+        Table.from_parquet(high_card_parquet), GROUPING, engine="single"
+    )
+    for analyzer in GROUPING:
+        got = ctx_stream.metric_map[analyzer].value.get()
+        want = ctx_mem.metric_map[analyzer].value.get()
+        assert got == pytest.approx(want, rel=1e-12), analyzer
+
+
+def test_streaming_high_card_mesh_engine(high_card_parquet):
+    source = ParquetSource(high_card_parquet, batch_rows=1 << 14)
+    from deequ_tpu.parallel.distributed import data_mesh
+
+    ctx = AnalysisRunner.do_analysis_run(
+        source, GROUPING, engine="distributed", mesh=data_mesh()
+    )
+    ctx_mem = AnalysisRunner.do_analysis_run(
+        Table.from_parquet(high_card_parquet), GROUPING, engine="single"
+    )
+    for analyzer in GROUPING:
+        assert ctx.metric_map[analyzer].value.get() == pytest.approx(
+            ctx_mem.metric_map[analyzer].value.get(), rel=1e-12
+        ), analyzer
+
+
+def test_spilled_state_is_actually_used(high_card_parquet):
+    source = ParquetSource(high_card_parquet, batch_rows=1 << 14)
+    state = compute_frequencies(source, ["id"])
+    assert isinstance(state, SpilledFrequencies)
+    assert state.num_rows == N_ROWS
+    # exact group count survives partition compaction: dup_key overwrote
+    # every 1000th id (120 ids gone, 1 new key)
+    assert state.num_groups == N_ROWS - N_ROWS // 1000 + 1
+
+
+def test_spill_accumulator_peak_memory_stays_bounded(high_card_parquet):
+    """The fold's resident group count never exceeds cap + one batch:
+    proxy assertion via the accumulator internals (the RSS-level
+    evidence lives in the 100M bench artifact)."""
+    acc = GroupCountAccumulator(["id"], max_groups_in_memory=10_000)
+    source = ParquetSource(high_card_parquet, batch_rows=1 << 14)
+    max_resident = 0
+    for batch in source.batches(1 << 14):
+        partial = compute_frequencies(batch, ["id"])
+        acc.add(partial)
+        if acc._buffer is not None:
+            max_resident = max(max_resident, acc._buffer.num_groups)
+    state = acc.finalize()
+    assert isinstance(state, SpilledFrequencies)
+    # once spilled, nothing accumulates in RAM; before, bounded by
+    # cap + one batch of new groups
+    assert max_resident <= 10_000 + (1 << 14)
+
+
+def test_histogram_over_spilled_state(high_card_parquet):
+    source = ParquetSource(high_card_parquet, batch_rows=1 << 14)
+    analyzer = Histogram("id", max_detail_bins=5)
+    ctx = AnalysisRunner.do_analysis_run(source, [analyzer], engine="single")
+    dist = ctx.metric_map[analyzer].value.get()
+    # top bin must be the repeated key, with its exact count
+    assert dist.values["dup_key"].absolute == N_ROWS // 1000
+    assert dist.number_of_bins == N_ROWS - N_ROWS // 1000 + 1
+    assert len(dist.values) == 5
+
+
+def test_histogram_streaming_state_actually_spills(high_card_parquet):
+    source = ParquetSource(high_card_parquet, batch_rows=1 << 14)
+    state = Histogram("id").compute_state_from(source)
+    assert isinstance(state, SpilledFrequencies)
+    assert state.num_rows == N_ROWS
+
+
+def test_spill_writer_cleans_up_on_abandonment():
+    """A fold that dies after spilling must not leak the spill dir."""
+    import gc
+    import os
+
+    from deequ_tpu.analyzers.freq_spill import _SpillWriter
+
+    writer = _SpillWriter(["c"])
+    writer.append(
+        FrequenciesAndNumRows(
+            ["c"],
+            [np.array(["a", "b"], dtype=object)],
+            np.array([1, 2], dtype=np.int64),
+            2,
+        )
+    )
+    directory = writer.directory
+    assert os.path.isdir(directory)
+    del writer
+    gc.collect()
+    assert not os.path.exists(directory)
+
+
+def test_mutual_information_over_spilled_state(high_card_parquet):
+    mi = MutualInformation("id", "cat")
+    source = ParquetSource(high_card_parquet, batch_rows=1 << 14)
+    ctx_stream = AnalysisRunner.do_analysis_run(source, [mi], engine="single")
+    ctx_mem = AnalysisRunner.do_analysis_run(
+        Table.from_parquet(high_card_parquet), [mi], engine="single"
+    )
+    assert ctx_stream.metric_map[mi].value.get() == pytest.approx(
+        ctx_mem.metric_map[mi].value.get(), rel=1e-9
+    )
+
+
+def test_spilled_merge_with_in_memory_partial():
+    rng = np.random.default_rng(5)
+    keys_a = np.array([f"k{i}" for i in range(30_000)], dtype=object)
+    keys_b = np.array([f"k{i}" for i in range(15_000, 45_000)], dtype=object)
+
+    acc = GroupCountAccumulator(["c"], max_groups_in_memory=5_000)
+    acc.add(
+        FrequenciesAndNumRows(
+            ["c"], [keys_a], np.ones(len(keys_a), dtype=np.int64), len(keys_a)
+        )
+    )
+    acc.add(
+        FrequenciesAndNumRows(
+            ["c"], [keys_b], np.ones(len(keys_b), dtype=np.int64), len(keys_b)
+        )
+    )
+    spilled = acc.finalize()
+    assert isinstance(spilled, SpilledFrequencies)
+    assert spilled.num_groups == 45_000
+    assert spilled.num_rows == 60_000
+
+    extra = FrequenciesAndNumRows(
+        ["c"],
+        [np.array(["k0", "new"], dtype=object)],
+        np.array([7, 3], dtype=np.int64),
+        10,
+    )
+    merged = spilled.merge(extra)
+    assert merged.num_groups == 45_001
+    assert merged.num_rows == 60_010
+    # merge must not mutate its operands (num_rows is the metric
+    # denominator downstream)
+    assert extra.num_rows == 10
+    assert spilled.num_rows == 60_000
+    # commutes through the in-memory side too
+    merged2 = extra.merge(spilled)
+    assert merged2.num_groups == 45_001
+    assert merged2.num_rows == 60_010
+    assert extra.num_rows == 10
+
+    # the overlapping key's count actually summed (k0: 1 from the first
+    # partial + 7 from the merged extra; keys_b starts at k15000)
+    total = 0
+    for part in merged.partitions():
+        for key, count in zip(part.key_columns[0], part.counts):
+            if key == "k0":
+                total += int(count)
+    assert total == 1 + 7
+
+
+def test_spilled_state_persists_via_state_provider(tmp_path, high_card_parquet):
+    from deequ_tpu.analyzers.state_provider import FileSystemStateProvider
+
+    source = ParquetSource(high_card_parquet, batch_rows=1 << 14)
+    state = compute_frequencies(source, ["id"])
+    assert isinstance(state, SpilledFrequencies)
+    provider = FileSystemStateProvider(str(tmp_path))
+    analyzer = Uniqueness(("id",))
+    provider.persist(analyzer, state)
+    loaded = provider.load(analyzer)
+    assert loaded.num_rows == state.num_rows
+    assert loaded.num_groups == state.num_groups
