@@ -7,7 +7,7 @@ spills instead of OOMing. This module is the engine-level equivalent:
 
   * `GroupCountAccumulator` folds per-batch `FrequenciesAndNumRows`
     partials in RAM until the accumulated group count crosses a cap
-    (DEEQU_TPU_MAX_GROUPS_IN_MEMORY, default 4M groups), then switches
+    (DEEQU_TPU_MAX_GROUPS_IN_MEMORY, default 2M groups), then switches
     to hash-partitioned disk spill: each partial's groups are routed by
     a stable 64-bit key hash into one of N partition files.
   * `finalize()` compacts each partition once (all chunks of a
@@ -93,6 +93,15 @@ class _SpillWriter:
             key_columns = [
                 partial.key_columns[partial.columns.index(c)] for c in self.columns
             ]
+        # hash/sort in row chunks (temporaries stay O(chunk)); buffer each
+        # partition's selections across chunks and write ONE file per
+        # partition per append — 64 files instead of 64 x n_chunks
+        per_part_keys: List[List[List[np.ndarray]]] = [
+            [] for _ in range(self.n_partitions)
+        ]
+        per_part_counts: List[List[np.ndarray]] = [
+            [] for _ in range(self.n_partitions)
+        ]
         for start in range(0, len(partial.counts), _ROUTE_CHUNK):
             stop = min(start + _ROUTE_CHUNK, len(partial.counts))
             kcs = [kc[start:stop] for kc in key_columns]
@@ -105,31 +114,40 @@ class _SpillWriter:
             boundaries = np.searchsorted(
                 sorted_parts, np.arange(self.n_partitions + 1)
             )
-            self._seq += 1
             for p in range(self.n_partitions):
                 lo, hi = boundaries[p], boundaries[p + 1]
                 if lo == hi:
                     continue
                 sel = order[lo:hi]
-                chunk = ([kc[sel] for kc in kcs], counts[sel])
-                path = os.path.join(
-                    self.directory, f"p{p:03d}_{self._seq:06d}.pkl"
-                )
-                with open(path, "wb") as f:
-                    pickle.dump(chunk, f, protocol=pickle.HIGHEST_PROTOCOL)
+                per_part_keys[p].append([kc[sel] for kc in kcs])
+                per_part_counts[p].append(counts[sel])
+        self._seq += 1
+        for p in range(self.n_partitions):
+            if not per_part_counts[p]:
+                continue
+            chunk = (
+                [
+                    np.concatenate([kcs[j] for kcs in per_part_keys[p]])
+                    for j in range(len(key_columns))
+                ],
+                np.concatenate(per_part_counts[p]),
+            )
+            path = os.path.join(self.directory, f"p{p:03d}_{self._seq:06d}.pkl")
+            with open(path, "wb") as f:
+                pickle.dump(chunk, f, protocol=pickle.HIGHEST_PROTOCOL)
 
     def finalize(self) -> "SpilledFrequencies":
         """Compact each partition to one chunk; record exact group count."""
         from deequ_tpu.analyzers.frequency import FrequenciesAndNumRows
 
         num_groups = 0
+        # one directory scan, bucketed by partition prefix
+        by_partition: dict = {}
+        for fn in os.listdir(self.directory):
+            if fn.startswith("p") and fn.endswith(".pkl") and "_" in fn:
+                by_partition.setdefault(fn[: fn.index("_")], []).append(fn)
         for p in range(self.n_partitions):
-            prefix = f"p{p:03d}_"
-            chunk_files = sorted(
-                fn
-                for fn in os.listdir(self.directory)
-                if fn.startswith(prefix) and fn.endswith(".pkl")
-            )
+            chunk_files = sorted(by_partition.get(f"p{p:03d}", []))
             if not chunk_files:
                 continue
             key_chunks: List[List[np.ndarray]] = []

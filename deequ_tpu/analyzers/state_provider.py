@@ -184,18 +184,28 @@ class FileSystemStateProvider(StateLoader, StatePersister):
     def _load_frequencies(self, identifier: str):
         import pyarrow.parquet as pq
 
-        from deequ_tpu.analyzers.base import COUNT_COL
-        from deequ_tpu.analyzers.frequency import FrequenciesAndNumRows
-
         pqt_path = self._path(identifier, "-frequencies.pqt")
         if not os.path.exists(pqt_path):
             return None
-        table = pq.read_table(pqt_path)
         with open(self._path(identifier, "-columns.txt"), encoding="utf-8") as f:
             columns = [line for line in f.read().split("\n") if line]
         with open(self._path(identifier, "-num_rows.bin"), "rb") as f:
             (num_rows,) = struct.unpack(">q", f.read())
-        return _frequencies_from_table(table, columns, num_rows)
+        # load row group by row group through the group-cap accumulator:
+        # a persisted high-cardinality state comes back SPILLED, keeping
+        # the persist/load round trip bounded-memory on both halves
+        from deequ_tpu.analyzers.freq_spill import GroupCountAccumulator
+
+        acc = GroupCountAccumulator(columns)
+        with pq.ParquetFile(pqt_path) as pf:
+            for g in range(pf.metadata.num_row_groups):
+                partial = _frequencies_from_table(
+                    pf.read_row_group(g), columns, 0
+                )
+                acc.add(partial)
+        state = acc.finalize()
+        state.num_rows = int(num_rows)
+        return state
 
 
 def serialize_state(analyzer: "Analyzer", state: State) -> bytes:
@@ -347,12 +357,38 @@ def _frequencies_from_table(table, columns, num_rows):
 
 
 def _serialize_frequencies_bytes(state) -> bytes:
-    """Envelope: ncols, utf8 names, numRows, in-memory Parquet payload."""
+    """Envelope: ncols, utf8 names, numRows, in-memory Parquet payload.
+
+    Spilled states stream partition by partition into the payload (one
+    row group each) — the bytes themselves are necessarily materialized
+    (they're about to cross DCN), but the object key set never is."""
     import pyarrow as pa
     import pyarrow.parquet as pq
 
+    from deequ_tpu.analyzers.base import COUNT_COL
+
     sink = pa.BufferOutputStream()
-    pq.write_table(pa.table(_frequencies_to_columns(state)), sink)
+    if getattr(state, "is_spilled", False):
+        writer = None
+        for part in state.partitions():
+            at = pa.table(_frequencies_to_columns(part))
+            if writer is None:
+                writer = pq.ParquetWriter(sink, at.schema)
+            writer.write_table(at)
+        if writer is None:
+            pq.write_table(
+                pa.table(
+                    {
+                        **{name: [] for name in state.columns},
+                        COUNT_COL: np.array([], dtype=np.int64),
+                    }
+                ),
+                sink,
+            )
+        else:
+            writer.close()
+    else:
+        pq.write_table(pa.table(_frequencies_to_columns(state)), sink)
     parquet = sink.getvalue().to_pybytes()
 
     parts = [struct.pack(">i", len(state.columns))]
@@ -379,8 +415,19 @@ def _deserialize_frequencies_bytes(data: bytes):
         offset += length
     num_rows, parquet_len = struct.unpack(">qi", data[offset : offset + 12])
     offset += 12
-    table = pq.read_table(pa.BufferReader(data[offset : offset + parquet_len]))
-    return _frequencies_from_table(table, columns, num_rows)
+    # row-group-wise through the group-cap accumulator: a high-cardinality
+    # envelope re-spills on the receiving host instead of materializing
+    from deequ_tpu.analyzers.freq_spill import GroupCountAccumulator
+
+    acc = GroupCountAccumulator(columns)
+    with pq.ParquetFile(
+        pa.BufferReader(data[offset : offset + parquet_len])
+    ) as pf:
+        for g in range(pf.metadata.num_row_groups):
+            acc.add(_frequencies_from_table(pf.read_row_group(g), columns, 0))
+    state = acc.finalize()
+    state.num_rows = int(num_rows)
+    return state
 
 
 def _serialize_kll(digest) -> bytes:

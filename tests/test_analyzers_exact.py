@@ -1,0 +1,439 @@
+"""Exact per-analyzer metric values incl. NaN/empty/failure cases — the
+depth of the reference's AnalyzerTests.scala (725 LoC) and
+NullHandlingTests.scala (144 LoC) on the FixtureSupport corpus."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Completeness,
+    Compliance,
+    Correlation,
+    CountDistinct,
+    DataType,
+    Distinctness,
+    Entropy,
+    Histogram,
+    Maximum,
+    Mean,
+    Minimum,
+    MutualInformation,
+    PatternMatch,
+    Size,
+    StandardDeviation,
+    Sum,
+    Uniqueness,
+    UniqueValueRatio,
+)
+from deequ_tpu.analyzers.sketch import ApproxQuantile
+from deequ_tpu.core.exceptions import (
+    EmptyStateException,
+    NoSuchColumnException,
+    WrongColumnTypeException,
+)
+from deequ_tpu.data.table import ColumnType, Table
+from deequ_tpu.runners.analysis_runner import AnalysisRunner
+from tests.fixtures import (
+    get_df_full,
+    get_df_missing,
+    get_df_with_conditionally_informative_columns,
+    get_df_with_conditionally_uninformative_columns,
+    get_df_with_distinct_values,
+    get_df_with_numeric_values,
+    get_df_with_unique_columns,
+    get_full_nulls,
+)
+
+
+def value_of(table: Table, analyzer):
+    return AnalysisRunner.do_analysis_run(table, [analyzer]).metric_map[
+        analyzer
+    ].value
+
+
+class TestSizeAnalyzer:
+    """reference: AnalyzerTests.scala:34-44."""
+
+    def test_exact_count(self):
+        assert value_of(get_df_missing(), Size()).get() == 12.0
+        assert value_of(get_df_full(), Size()).get() == 4.0
+
+    def test_filtered_count(self):
+        assert value_of(get_df_full(), Size(where="att1 = 'a'")).get() == 3.0
+
+    def test_empty_table(self):
+        t = Table.from_pydict({"x": []})
+        assert value_of(t, Size()).get() == 0.0
+
+
+class TestCompletenessAnalyzer:
+    """reference: AnalyzerTests.scala:46-77."""
+
+    def test_exact_fractions(self):
+        t = get_df_missing()
+        assert value_of(t, Completeness("att1")).get() == 0.5
+        assert value_of(t, Completeness("att2")).get() == 0.75
+
+    def test_wrong_column_fails_typed(self):
+        v = value_of(get_df_missing(), Completeness("nonExistingColumn"))
+        assert v.is_failure
+        assert isinstance(v.exception, NoSuchColumnException)
+
+    def test_with_filtering(self):
+        # reference :70-77: rows where item in (1,2): att1 = a,b both present
+        t = get_df_missing()
+        assert value_of(
+            t, Completeness("att1", where="item = '1' OR item = '2'")
+        ).get() == 1.0
+
+    def test_all_null_column_is_zero(self):
+        assert value_of(get_full_nulls(), Completeness("att1")).get() == 0.0
+
+
+class TestUniquenessAnalyzers:
+    """reference: AnalyzerTests.scala:79-132."""
+
+    def test_single_column_values(self):
+        t = get_df_with_unique_columns()
+        assert value_of(t, Uniqueness(("unique",))).get() == 1.0
+        assert value_of(t, Uniqueness(("uniqueWithNulls",))).get() \
+            == pytest.approx(5 / 6)
+        assert value_of(t, Uniqueness(("nonUnique",))).get() == pytest.approx(3 / 6)
+
+    def test_multi_column_values(self):
+        t = get_df_full()
+        # (att1, att2) pairs: (a,c)x3, (b,d)x1 -> 1 unique of 4 rows
+        assert value_of(t, Uniqueness(("att1", "att2"))).get() == pytest.approx(1 / 4)
+
+    def test_wrong_column_fails(self):
+        v = value_of(get_df_full(), Uniqueness(("nonExistent",)))
+        assert v.is_failure
+        assert isinstance(v.exception, NoSuchColumnException)
+
+    def test_unique_value_ratio(self):
+        t = get_df_with_unique_columns()
+        # nonUnique groups: {0:3, 5:1, 6:1, 7:1} -> 3 unique / 4 groups
+        assert value_of(t, UniqueValueRatio(("nonUnique",))).get() == pytest.approx(0.75)
+
+    def test_distinctness(self):
+        t = get_df_with_distinct_values()
+        assert value_of(t, Distinctness(("att1",))).get() == pytest.approx(3 / 6)
+        assert value_of(t, Distinctness(("att2",))).get() == pytest.approx(2 / 6)
+
+    def test_count_distinct_exact(self):
+        t = get_df_with_distinct_values()
+        assert value_of(t, CountDistinct(("att1",))).get() == 3.0
+        assert value_of(t, CountDistinct(("att2",))).get() == 2.0
+
+
+class TestEntropyAndMI:
+    """reference: AnalyzerTests.scala:134-170."""
+
+    def test_entropy_exact(self):
+        t = get_df_full()  # att1: a x3, b x1
+        expected = -(0.75 * math.log(0.75) + 0.25 * math.log(0.25))
+        assert value_of(t, Entropy("att1")).get() == pytest.approx(expected, rel=1e-12)
+
+    def test_mi_uninformative_is_zero(self):
+        t = get_df_with_conditionally_uninformative_columns()
+        assert value_of(t, MutualInformation("att1", "att2")).get() \
+            == pytest.approx(0.0, abs=1e-12)
+
+    def test_mi_informative_equals_entropy(self):
+        # att1 fully determines att2 (both unique): MI == H(att1)
+        t = get_df_with_conditionally_informative_columns()
+        mi = value_of(t, MutualInformation("att1", "att2")).get()
+        h = value_of(t, Entropy("att1")).get()
+        assert mi == pytest.approx(h, rel=1e-12)
+
+    def test_mi_requires_two_columns(self):
+        v = value_of(
+            get_df_with_numeric_values(), MutualInformation(["att1", "att2", "item"])
+        )
+        assert v.is_failure
+
+
+class TestComplianceAnalyzer:
+    """reference: AnalyzerTests.scala:172-200."""
+
+    def test_exact_fraction(self):
+        t = get_df_with_numeric_values()
+        assert value_of(t, Compliance("rule1", "att1 > 3")).get() == pytest.approx(0.5)
+        assert value_of(t, Compliance("rule2", "att1 > 0")).get() == 1.0
+
+    def test_filtered(self):
+        t = get_df_with_numeric_values()
+        assert value_of(
+            t, Compliance("rule", "att2 > 0", where="att1 > 3")
+        ).get() == 1.0
+
+    def test_bad_expression_fails(self):
+        v = value_of(get_df_with_numeric_values(), Compliance("bad", "att1 > > 3"))
+        assert v.is_failure
+
+
+class TestHistogramAnalyzer:
+    """reference: AnalyzerTests.scala:202-272."""
+
+    def test_exact_distribution(self):
+        dist = value_of(get_df_missing(), Histogram("att1")).get()
+        assert dist.number_of_bins == 3  # a, b, NullValue
+        assert dist.values["a"].absolute == 4
+        assert dist.values["b"].absolute == 2
+        assert dist.values["NullValue"].absolute == 6
+        assert dist.values["a"].ratio == pytest.approx(4 / 12)
+
+    def test_numeric_values_stringified(self):
+        dist = value_of(get_df_with_numeric_values(), Histogram("att1")).get()
+        assert dist.number_of_bins == 6
+        assert dist.values["1"].absolute == 1
+
+    def test_binning_udf(self):
+        # reference :229-248 bins by even/odd
+        dist = value_of(
+            get_df_with_numeric_values(),
+            Histogram("att1", binning_udf=lambda v: "even" if v % 2 == 0 else "odd"),
+        ).get()
+        assert dist.number_of_bins == 2
+        assert dist.values["even"].absolute == 3
+        assert dist.values["odd"].absolute == 3
+
+    def test_top_n_bins_only(self):
+        dist = value_of(
+            get_df_missing(), Histogram("att1", max_detail_bins=2)
+        ).get()
+        # number_of_bins reports ALL groups; details keep top-N
+        assert dist.number_of_bins == 3
+        assert len(dist.values) == 2
+        assert "NullValue" in dist.values and "a" in dist.values
+
+    def test_max_detail_bins_cap(self):
+        v = value_of(get_df_missing(), Histogram("att1", max_detail_bins=1001))
+        assert v.is_failure
+
+
+class TestDataTypeAnalyzer:
+    """reference: AnalyzerTests.scala:274-421 — the full decision table."""
+
+    def _hist(self, values, types=None):
+        t = Table.from_pydict({"v": values}, types=types)
+        return value_of(t, DataType("v")).get()
+
+    def test_integral_strings(self):
+        d = self._hist(["1", "2", "3"])
+        assert d.values["Integral"].absolute == 3
+        assert d.values["Integral"].ratio == 1.0
+
+    def test_negative_integrals(self):
+        d = self._hist(["-1", "-2", "+3"])
+        assert d.values["Integral"].absolute == 3
+
+    def test_fractional_strings(self):
+        d = self._hist(["1.0", "-2.0", "+3.5"])
+        assert d.values["Fractional"].absolute == 3
+
+    def test_mixed_fractional_and_integral(self):
+        d = self._hist(["1", "2.0"])
+        assert d.values["Integral"].absolute == 1
+        assert d.values["Fractional"].absolute == 1
+
+    def test_booleans(self):
+        d = self._hist(["true", "false", "true"])
+        assert d.values["Boolean"].absolute == 3
+
+    def test_fallback_to_string(self):
+        d = self._hist(["a", "1", "1.0"])
+        assert d.values["String"].absolute == 1
+        assert d.values["Integral"].absolute == 1
+        assert d.values["Fractional"].absolute == 1
+
+    def test_null_class(self):
+        d = self._hist(["1", None, "2"])
+        assert d.values["Unknown"].absolute == 1
+        assert d.values["Integral"].absolute == 2
+
+    def test_typed_numeric_column_is_static(self):
+        d = self._hist([1.0, 2.0, 3.0])
+        assert d.values["Fractional"].absolute == 3
+
+    def test_where_filtered_rows_are_unknown(self):
+        t = Table.from_pydict({"v": ["1", "2", "x"], "k": [1, 2, 3]})
+        analyzer = DataType("v", where="k < 3")
+        d = value_of(t, analyzer).get()
+        assert d.values["Integral"].absolute == 2
+        assert d.values["Unknown"].absolute == 1
+
+
+class TestBasicStatistics:
+    """reference: AnalyzerTests.scala:424-506."""
+
+    def test_mean(self):
+        assert value_of(get_df_with_numeric_values(), Mean("att1")).get() == 3.5
+
+    def test_mean_with_where(self):
+        assert value_of(
+            get_df_with_numeric_values(), Mean("att1", where="att2 > 0")
+        ).get() == 5.0
+
+    def test_mean_fails_on_non_numeric(self):
+        v = value_of(get_df_full(), Mean("att1"))
+        assert v.is_failure
+        assert isinstance(v.exception, WrongColumnTypeException)
+
+    def test_stddev_population(self):
+        expected = float(np.std(np.arange(1, 7)))
+        assert value_of(
+            get_df_with_numeric_values(), StandardDeviation("att1")
+        ).get() == pytest.approx(expected, rel=1e-12)
+
+    def test_stddev_fails_on_non_numeric(self):
+        assert value_of(get_df_full(), StandardDeviation("att1")).is_failure
+
+    def test_minimum_maximum_sum(self):
+        t = get_df_with_numeric_values()
+        assert value_of(t, Minimum("att1")).get() == 1.0
+        assert value_of(t, Maximum("att1")).get() == 6.0
+        assert value_of(t, Sum("att1")).get() == 21.0
+
+    def test_maximum_with_filtering(self):
+        assert value_of(
+            get_df_with_numeric_values(), Maximum("att1", where="item <= '3'")
+        ).get() == 3.0
+
+    def test_min_max_fail_on_non_numeric(self):
+        assert value_of(get_df_full(), Minimum("att1")).is_failure
+        assert value_of(get_df_full(), Maximum("att1")).is_failure
+        assert value_of(get_df_full(), Sum("att1")).is_failure
+
+    def test_correlation_exact(self):
+        t = get_df_with_conditionally_informative_columns()
+        assert value_of(t, Correlation("att1", "att2")).get() == pytest.approx(1.0)
+
+    def test_correlation_of_constant_is_nan_or_failure(self):
+        t = get_df_with_conditionally_uninformative_columns()
+        v = value_of(t, Correlation("att1", "att2"))
+        # zero variance in att2: Pearson r undefined
+        assert v.is_failure or math.isnan(v.get())
+
+    def test_decimal_columns_work(self):
+        t = Table.from_pydict(
+            {"v": [1.0, 2.0, 3.0]}, types={"v": ColumnType.DECIMAL}
+        )
+        assert value_of(t, Sum("v")).get() == 6.0
+        assert value_of(t, Mean("v")).get() == 2.0
+
+
+class TestCountDistinctFamily:
+    """reference: AnalyzerTests.scala:508-560."""
+
+    def test_approx_count_distinct_small_exact(self):
+        t = get_df_with_numeric_values()
+        assert value_of(t, ApproxCountDistinct("att1")).get() == 6.0
+
+    def test_approx_count_distinct_with_filtering(self):
+        t = get_df_with_numeric_values()
+        assert value_of(
+            t, ApproxCountDistinct("att1", where="att2 = 0")
+        ).get() == 3.0
+
+    def test_approx_quantile_exact_at_small_n(self):
+        t = get_df_with_numeric_values()
+        v = value_of(t, ApproxQuantile("att1", 0.5)).get()
+        assert 3.0 <= v <= 4.0
+        assert value_of(t, ApproxQuantile("att1", 0.0)).get() == 1.0
+        assert value_of(t, ApproxQuantile("att1", 1.0)).get() == 6.0
+
+    def test_approx_quantile_rejects_bad_params(self):
+        t = get_df_with_numeric_values()
+        assert value_of(t, ApproxQuantile("att1", 1.5)).is_failure
+        assert value_of(t, ApproxQuantile("att1", -0.1)).is_failure
+
+
+class TestPatternMatchAnalyzer:
+    def test_exact_fraction(self):
+        t = Table.from_pydict({"v": ["ab12", "cd34", "xxxx"]})
+        assert value_of(t, PatternMatch("v", r"[a-z]{2}\d{2}")).get() \
+            == pytest.approx(2 / 3)
+
+    def test_null_values_dont_match(self):
+        t = Table.from_pydict({"v": ["12", None, "ab"]})
+        assert value_of(t, PatternMatch("v", r"\d+")).get() == pytest.approx(1 / 3)
+
+
+class TestNullHandling:
+    """reference: NullHandlingTests.scala:55-133 — empty states vs zero
+    values, and analyzer names in EmptyStateExceptions."""
+
+    def _null_table(self) -> Table:
+        return Table.from_pydict(
+            {
+                "stringCol": [None, None, None],
+                "numCol": [None, None, None],
+            },
+            types={
+                "stringCol": ColumnType.STRING,
+                "numCol": ColumnType.DOUBLE,
+            },
+        )
+
+    def test_size_still_counts(self):
+        assert value_of(self._null_table(), Size()).get() == 3.0
+
+    def test_completeness_zero_not_failure(self):
+        v = value_of(self._null_table(), Completeness("stringCol"))
+        assert v.is_success and v.get() == 0.0
+
+    def test_numeric_analyzers_empty_state(self):
+        t = self._null_table()
+        for analyzer in (
+            Mean("numCol"),
+            Minimum("numCol"),
+            Maximum("numCol"),
+            Sum("numCol"),
+            StandardDeviation("numCol"),
+        ):
+            v = value_of(t, analyzer)
+            assert v.is_failure, analyzer
+            assert isinstance(v.exception, EmptyStateException), analyzer
+            # reference :122-133: the exception names the analyzer
+            assert analyzer.name in str(v.exception) or repr(analyzer) in str(
+                v.exception
+            ), analyzer
+
+    def test_approx_count_distinct_of_all_null_is_zero(self):
+        assert value_of(self._null_table(), ApproxCountDistinct("stringCol")).get() \
+            == 0.0
+
+    def test_compliance_on_all_null_criterion(self):
+        # where filter excludes everything -> criterion never non-NULL
+        t = get_df_with_numeric_values()
+        v = value_of(t, Compliance("none", "att1 > 0", where="att1 > 100"))
+        assert v.is_failure
+        assert isinstance(v.exception, EmptyStateException)
+
+    def test_grouping_analyzers_on_all_null(self):
+        t = self._null_table()
+        assert value_of(t, CountDistinct(("stringCol",))).get() == 0.0
+        v = value_of(t, Uniqueness(("stringCol",)))
+        assert v.is_failure  # SQL sum over empty -> NULL
+
+    def test_incremental_merge_with_all_null_partition(self):
+        from deequ_tpu.analyzers.state_provider import InMemoryStateProvider
+
+        full = get_df_with_numeric_values()
+        nulls = Table.from_pydict(
+            {"item": ["7"], "att1": [None], "att2": [None]},
+            types={"att1": ColumnType.LONG, "att2": ColumnType.LONG},
+        )
+        p1, p2 = InMemoryStateProvider(), InMemoryStateProvider()
+        AnalysisRunner.do_analysis_run(full, [Mean("att1")], save_states_with=p1)
+        AnalysisRunner.do_analysis_run(nulls, [Mean("att1")], save_states_with=p2)
+        analyzer = Mean("att1")
+        state1 = p1.load(analyzer)
+        assert p2.load(analyzer) is None  # empty contribution
+        assert analyzer.compute_metric_from(state1).value.get() == 3.5

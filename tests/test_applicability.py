@@ -1,0 +1,150 @@
+"""Dedicated applicability-checker tests — the mirror of the reference's
+checks/ApplicabilityTest.scala (recognize applicable checks, detect
+non-existing columns, invalid expressions) plus the typed random-data
+generator's contracts (reference: analyzers/applicability/Applicability.scala)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deequ_tpu import Check, CheckLevel
+from deequ_tpu.analyzers import Completeness, Compliance, Mean, Size
+from deequ_tpu.applicability.applicability import (
+    Applicability,
+    SchemaField,
+    generate_random_data,
+)
+from deequ_tpu.data.table import ColumnType
+from deequ_tpu.verification.suite import VerificationSuite
+
+SCHEMA = [
+    SchemaField("item", ColumnType.STRING, nullable=False),
+    SchemaField("att1", ColumnType.STRING),
+    SchemaField("count", ColumnType.LONG),
+    SchemaField("price", ColumnType.DOUBLE),
+    SchemaField("flag", ColumnType.BOOLEAN),
+    SchemaField("dec", ColumnType.DECIMAL, precision=10, scale=2),
+    SchemaField("ts", ColumnType.TIMESTAMP),
+]
+
+
+class TestRandomDataGenerator:
+    """reference: Applicability.scala:46-155."""
+
+    def test_all_types_generate(self):
+        t = generate_random_data(SCHEMA, 1000, seed=1)
+        assert t.num_rows == 1000
+        assert [name for name, _ in t.schema] == [f.name for f in SCHEMA]
+        types = dict(t.schema)
+        assert types["count"] == ColumnType.LONG
+        assert types["price"] == ColumnType.DOUBLE
+        assert types["flag"] == ColumnType.BOOLEAN
+        assert types["ts"] == ColumnType.TIMESTAMP
+
+    def test_nullable_fields_get_about_one_percent_nulls(self):
+        t = generate_random_data(SCHEMA, 20_000, seed=2)
+        null_fraction = t.column("att1").null_count / 20_000
+        assert 0.002 < null_fraction < 0.03
+        # non-nullable fields get none
+        assert t.column("item").null_count == 0
+
+    def test_decimal_respects_precision_and_scale(self):
+        t = generate_random_data(
+            [SchemaField("d", ColumnType.DECIMAL, nullable=False, precision=6, scale=2)],
+            500,
+            seed=3,
+        )
+        vals = t.column("d").values
+        assert np.all(vals < 10**6)
+        assert np.all(vals >= 0)
+
+    def test_string_lengths_bounded(self):
+        t = generate_random_data(
+            [SchemaField("s", ColumnType.STRING, nullable=False)], 500, seed=4
+        )
+        lengths = [len(v) for v in t.column("s").values]
+        assert min(lengths) >= 1 and max(lengths) <= 20
+
+
+class TestCheckApplicability:
+    """reference: ApplicabilityTest.scala:49-178."""
+
+    def test_recognizes_applicable_check(self):
+        check = (
+            Check(CheckLevel.ERROR, "applicable")
+            .is_complete("item")
+            .has_completeness("att1", lambda v: v > 0.5)
+            .has_mean("price", lambda v: True)
+            .has_size(lambda n: n > 0)
+        )
+        result = Applicability().is_applicable(check, SCHEMA)
+        assert result.is_applicable
+        assert not result.failures
+        assert all(result.constraint_applicabilities.values())
+        assert len(result.constraint_applicabilities) == 4
+
+    def test_detects_non_existing_column(self):
+        check = Check(CheckLevel.ERROR, "bad").is_complete("notThere")
+        result = Applicability().is_applicable(check, SCHEMA)
+        assert not result.is_applicable
+        assert result.failures
+        assert any("notThere" in name for name, _ in result.failures)
+
+    def test_detects_wrong_type(self):
+        check = Check(CheckLevel.ERROR, "bad").has_mean("att1", lambda v: True)
+        result = Applicability().is_applicable(check, SCHEMA)
+        assert not result.is_applicable
+
+    def test_detects_invalid_expression(self):
+        check = Check(CheckLevel.ERROR, "bad").satisfies(
+            "count > > 3", "broken expression"
+        )
+        result = Applicability().is_applicable(check, SCHEMA)
+        assert not result.is_applicable
+
+    def test_partial_applicability_maps_per_constraint(self):
+        check = (
+            Check(CheckLevel.ERROR, "mixed")
+            .is_complete("item")
+            .is_complete("missing")
+        )
+        result = Applicability().is_applicable(check, SCHEMA)
+        assert not result.is_applicable
+        applicable = list(result.constraint_applicabilities.values())
+        assert applicable.count(True) == 1
+        assert applicable.count(False) == 1
+
+
+class TestAnalyzersApplicability:
+    def test_applicable_analyzers(self):
+        result = Applicability().are_applicable(
+            [Size(), Completeness("att1"), Mean("price")], SCHEMA
+        )
+        assert result.is_applicable
+        assert not result.failures
+
+    def test_failures_carry_instance_and_exception(self):
+        result = Applicability().are_applicable(
+            [Mean("att1"), Compliance("c", "price > > 1")], SCHEMA
+        )
+        assert not result.is_applicable
+        assert len(result.failures) == 2
+        for _instance, exception in result.failures:
+            assert isinstance(exception, BaseException)
+
+
+class TestSuiteIntegration:
+    """reference: VerificationSuite.isCheckApplicableToData
+    (VerificationSuite.scala:238-261)."""
+
+    def test_is_check_applicable_to_data(self):
+        # takes a schema, like the reference's StructType overload
+        ok = VerificationSuite.is_check_applicable_to_data(
+            Check(CheckLevel.ERROR, "c").is_complete("att1"), SCHEMA
+        )
+        assert ok.is_applicable
+        bad = VerificationSuite.is_check_applicable_to_data(
+            Check(CheckLevel.ERROR, "c").is_complete("zzz"), SCHEMA
+        )
+        assert not bad.is_applicable
