@@ -123,3 +123,24 @@ class TestAnalyzerContext:
         c2 = AnalysisRunner.on_data(df).add_analyzer(Mean("att1")).run()
         merged = c1 + c2
         assert len(merged.metric_map) == 2
+
+
+def test_deprecated_analysis_container():
+    """reference: analyzers/Analysis.scala:29-63 — the legacy bag of
+    analyzers, deprecated in favor of AnalysisRunner.on_data."""
+    import warnings
+
+    import numpy as np
+
+    from deequ_tpu.analyzers import Analysis, Mean, Size
+    from deequ_tpu.data.table import Table
+
+    analysis = Analysis().add_analyzer(Size()).add_analyzers([Mean("x")])
+    assert len(analysis.analyzers) == 2
+    table = Table.from_numpy({"x": np.array([1.0, 2.0, 3.0])})
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        ctx = analysis.run(table)
+    assert any(issubclass(w.category, DeprecationWarning) for w in caught)
+    assert ctx.metric_map[Size()].value.get() == 3.0
+    assert ctx.metric_map[Mean("x")].value.get() == 2.0
