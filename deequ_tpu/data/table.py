@@ -114,32 +114,6 @@ class Column:
 
         Returned arrays are shared (cached / possibly the column's own
         backing store): callers must treat them as immutable."""
-        if self.ctype == ColumnType.BOOLEAN:
-            return self.values.astype(np.float64), self.valid
-        if self.ctype == ColumnType.TIMESTAMP:
-            vals = self.values.astype("datetime64[us]").astype(np.int64).astype(np.float64)
-            return vals, self.valid
-        if self.ctype == ColumnType.STRING:
-            cached = self._cache.get("numeric_values")
-            if cached is None:
-                parent = getattr(self, "_parent", None)
-                if parent is not None:
-                    p, start, stop = parent
-                    p_vals, p_valid = p.numeric_values()
-                    cached = (p_vals[start:stop], p_valid[start:stop])
-                else:
-                    from deequ_tpu.ops.strings import parse_floats
-
-                    codes, uniques = self.dict_encode()
-                    u_vals, u_ok = parse_floats(uniques)
-                    out = np.zeros(len(self.values), dtype=np.float64)
-                    valid = np.zeros(len(self.values), dtype=np.bool_)
-                    sel = codes >= 0
-                    out[sel] = u_vals[codes[sel]]
-                    valid[sel] = u_ok[codes[sel]]
-                    cached = (out, valid)
-                self._cache["numeric_values"] = cached
-            return cached
         if self.ctype == ColumnType.DOUBLE or self.ctype == ColumnType.DECIMAL:
             # constructors fill null slots with 0.0, so the backing array
             # is directly usable under mask algebra (0 * mask == 0, no NaN
@@ -147,10 +121,36 @@ class Column:
             return self.values, self.valid
         cached = self._cache.get("numeric_values")
         if cached is None:
-            cached = (
-                np.where(self.valid, self.values.astype(np.float64), 0.0),
-                self.valid,
-            )
+            parent = getattr(self, "_parent", None)
+            if parent is not None:
+                # slice of the parent's cached conversion: one float64
+                # materialization per TABLE, not one per batch per pass
+                p, start, stop = parent
+                p_vals, p_valid = p.numeric_values()
+                cached = (p_vals[start:stop], p_valid[start:stop])
+            elif self.ctype == ColumnType.BOOLEAN:
+                cached = (self.values.astype(np.float64), self.valid)
+            elif self.ctype == ColumnType.TIMESTAMP:
+                cached = (
+                    self.values.astype("datetime64[us]")
+                    .astype(np.int64)
+                    .astype(np.float64),
+                    self.valid,
+                )
+            elif self.ctype == ColumnType.STRING:
+                from deequ_tpu.ops.strings import parse_floats
+
+                codes, uniques = self.dict_encode()
+                u_vals, u_ok = parse_floats(uniques)
+                cached = (
+                    gather_with_null(u_vals, codes, 0.0),
+                    gather_with_null(u_ok, codes, False),
+                )
+            else:  # LONG
+                cached = (
+                    np.where(self.valid, self.values.astype(np.float64), 0.0),
+                    self.valid,
+                )
             self._cache["numeric_values"] = cached
         return cached
 
