@@ -316,16 +316,21 @@ class Patterns:
 def _match_spec(column: str, pattern: str) -> InputSpec:
     re.compile(pattern)  # fail fast on a bad pattern, at spec-build time
 
-    def build(t: Table) -> np.ndarray:
+    def compute(col) -> np.ndarray:
+        from deequ_tpu.data.table import gather_with_null
         from deequ_tpu.ops.strings import match_pattern
 
-        from deequ_tpu.data.table import gather_with_null
-
-        col = t.column(column)
         # regex only the unique values (typically << rows), gather to
         # rows; null rows map to False
         codes, uniques = col.dict_encode()
         return gather_with_null(match_pattern(uniques, pattern), codes, False)
+
+    def build(t: Table) -> np.ndarray:
+        from deequ_tpu.data.table import cached_column_encode
+
+        return cached_column_encode(
+            t.column(column), f"match:{pattern}", compute
+        )
 
     return InputSpec(key=f"match:{column}:{pattern}", build=build, columns=(column,))
 
@@ -775,10 +780,9 @@ from deequ_tpu.ops.strings import (  # noqa: E402
 
 
 def _dtclass_spec(column: str) -> InputSpec:
-    def build(t: Table) -> np.ndarray:
+    def compute(col) -> np.ndarray:
         from deequ_tpu.ops.strings import classify
 
-        col = t.column(column)
         if col.ctype == ColumnType.STRING:
             # classify unique strings only; null rows map to the NULL
             # class. int8: 5 classes, and the narrow dtype is both the
@@ -798,6 +802,12 @@ def _dtclass_spec(column: str) -> InputSpec:
             ColumnType.TIMESTAMP: _CODE_STRING,
         }[col.ctype]
         return np.where(col.valid, np.int8(static), np.int8(_CODE_NULL))
+
+    def build(t: Table) -> np.ndarray:
+        from deequ_tpu.data.table import cached_column_encode
+
+        # column-deterministic: memoized per table, sliced per batch
+        return cached_column_encode(t.column(column), "dtclass", compute)
 
     return InputSpec(key=f"dtclass:{column}", build=build, columns=(column,))
 

@@ -70,10 +70,9 @@ def _hll_spec(column: str) -> InputSpec:
     is hashed exactly once per batch; invalid rows pack to 0 (idx 0,
     rank 0 — a no-op for the scatter-max)."""
 
-    def build(t: Table) -> np.ndarray:
+    def compute(col) -> np.ndarray:
         from deequ_tpu.data.table import ColumnType
 
-        col = t.column(column)
         if col.ctype == ColumnType.STRING:
             # share the batch's dict-encode; hash unique strings only;
             # null rows map to packed code 0 (idx 0, rank 0 — a no-op
@@ -88,6 +87,12 @@ def _hll_spec(column: str) -> InputSpec:
             )
         # one-pass C kernel when available, identical numpy codes otherwise
         return hll.pack_codes(col.values, col.valid)
+
+    def build(t: Table) -> np.ndarray:
+        from deequ_tpu.data.table import cached_column_encode
+
+        # column-deterministic: memoized per table, sliced per batch
+        return cached_column_encode(t.column(column), "hll_packed", compute)
 
     return InputSpec(key=f"hll:{column}", build=build, columns=(column,))
 

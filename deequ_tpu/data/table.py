@@ -119,40 +119,38 @@ class Column:
             # is directly usable under mask algebra (0 * mask == 0, no NaN
             # poisoning) — no per-batch materialization
             return self.values, self.valid
-        cached = self._cache.get("numeric_values")
-        if cached is None:
-            parent = getattr(self, "_parent", None)
-            if parent is not None:
-                # slice of the parent's cached conversion: one float64
-                # materialization per TABLE, not one per batch per pass
-                p, start, stop = parent
-                p_vals, p_valid = p.numeric_values()
-                cached = (p_vals[start:stop], p_valid[start:stop])
-            elif self.ctype == ColumnType.BOOLEAN:
-                cached = (self.values.astype(np.float64), self.valid)
-            elif self.ctype == ColumnType.TIMESTAMP:
-                cached = (
-                    self.values.astype("datetime64[us]")
+
+        def compute(col: "Column"):
+            if col.ctype == ColumnType.BOOLEAN:
+                return col.values.astype(np.float64), col.valid
+            if col.ctype == ColumnType.TIMESTAMP:
+                return (
+                    col.values.astype("datetime64[us]")
                     .astype(np.int64)
                     .astype(np.float64),
-                    self.valid,
+                    col.valid,
                 )
-            elif self.ctype == ColumnType.STRING:
+            if col.ctype == ColumnType.STRING:
                 from deequ_tpu.ops.strings import parse_floats
 
-                codes, uniques = self.dict_encode()
+                codes, uniques = col.dict_encode()
                 u_vals, u_ok = parse_floats(uniques)
-                cached = (
+                return (
                     gather_with_null(u_vals, codes, 0.0),
                     gather_with_null(u_ok, codes, False),
                 )
-            else:  # LONG
-                cached = (
-                    np.where(self.valid, self.values.astype(np.float64), 0.0),
-                    self.valid,
-                )
-            self._cache["numeric_values"] = cached
-        return cached
+            # LONG
+            return (
+                np.where(col.valid, col.values.astype(np.float64), 0.0),
+                col.valid,
+            )
+
+        return cached_column_encode(
+            self,
+            "numeric_values",
+            compute,
+            slicer=lambda v, s, e: (v[0][s:e], v[1][s:e]),
+        )
 
     def as_float(self) -> np.ndarray:
         """Values as float64; null/unparseable slots = 0.0 (mask separately
@@ -167,78 +165,90 @@ class Column:
         Column instance — every string analyzer on a batch shares one
         encode.
         """
-        cached = self._cache.get("dict_encode")
-        if cached is not None:
-            return cached
-        parent = getattr(self, "_parent", None)
+        return cached_column_encode(
+            self,
+            "dict_encode",
+            _compute_dict_encode,
+            # codes slice row-wise; the dictionary is shared whole
+            slicer=lambda v, s, e: (v[0][s:e], v[1]),
+        )
+
+
+def _compute_dict_encode(col: "Column") -> Tuple[np.ndarray, np.ndarray]:
+    if not col.valid.any():
+        return (
+            np.full(len(col.values), -1, dtype=np.int64),
+            np.array([], dtype=object),
+        )
+    arrow_arr = col._cache.get("arrow")
+    if arrow_arr is not None:
+        # arrow-backed string column: hash-based C dictionary encode
+        return _arrow_dict_encode(arrow_arr)
+    if col.ctype == ColumnType.STRING:
+        # arrow's hash-based dictionary encode is ~8x numpy's sort-based
+        # unique on object arrays (measured: 0.6s vs 5.2s per 4M rows);
+        # fall back to np.unique only without pyarrow
+        try:
+            import pyarrow as pa
+
+            return _arrow_dict_encode(
+                pa.array(
+                    col.values,
+                    type=pa.string(),
+                    mask=None if col.valid.all() else ~col.valid,
+                )
+            )
+        except ImportError:
+            pass
+        except pa.lib.ArrowException:
+            # backing values that aren't str (mixed object arrays,
+            # numeric values under a STRING ctype): the numpy path
+            # below stringifies them
+            pass
+    vals = col.values[col.valid]
+    if col.ctype == ColumnType.STRING:
+        vals = vals.astype(str)
+    uniques, inv = np.unique(vals, return_inverse=True)
+    codes = np.full(len(col.values), -1, dtype=np.int64)
+    codes[col.valid] = inv
+    return codes, uniques
+
+
+def _arrow_dict_encode(arrow_arr) -> Tuple[np.ndarray, np.ndarray]:
+    encoded = arrow_arr.dictionary_encode()
+    codes = (
+        encoded.indices.fill_null(-1)
+        .to_numpy(zero_copy_only=False)
+        .astype(np.int64)
+    )
+    uniques = encoded.dictionary.to_numpy(zero_copy_only=False)
+    if uniques.dtype != object:
+        uniques = uniques.astype(object)
+    return codes, uniques
+
+
+def cached_column_encode(col: "Column", key: str, compute, slicer=None):
+    """Column-deterministic derived encoding, memoized on the Column with
+    parent-slice delegation: one materialization per TABLE, batches slice
+    it. `compute(column)` builds the full-column value on the root
+    column; `slicer(value, start, stop)` produces a batch view of it
+    (default: plain array slicing — pass one when the cached value is a
+    tuple with non-row-wise parts, e.g. dict_encode's uniques)."""
+    cached = col._cache.get(key)
+    if cached is None:
+        parent = getattr(col, "_parent", None)
         if parent is not None:
             p, start, stop = parent
-            p_codes, p_uniques = p.dict_encode()
-            out = (p_codes[start:stop], p_uniques)
-            self._cache["dict_encode"] = out
-            return out
-        if not self.valid.any():
-            out = (
-                np.full(len(self.values), -1, dtype=np.int64),
-                np.array([], dtype=object),
+            whole = cached_column_encode(p, key, compute, slicer)
+            cached = (
+                slicer(whole, start, stop)
+                if slicer is not None
+                else whole[start:stop]
             )
-            self._cache["dict_encode"] = out
-            return out
-        arrow_arr = self._cache.get("arrow")
-        if arrow_arr is not None:
-            # arrow-backed string column: hash-based C dictionary encode
-            encoded = arrow_arr.dictionary_encode()
-            codes = (
-                encoded.indices.fill_null(-1)
-                .to_numpy(zero_copy_only=False)
-                .astype(np.int64)
-            )
-            uniques = encoded.dictionary.to_numpy(zero_copy_only=False)
-            if uniques.dtype != object:
-                uniques = uniques.astype(object)
-            out = (codes, uniques)
-            self._cache["dict_encode"] = out
-            return out
-        if self.ctype == ColumnType.STRING:
-            # arrow's hash-based dictionary encode is ~8x numpy's
-            # sort-based unique on object arrays (measured: 0.6s vs 5.2s
-            # per 4M rows); fall back to np.unique only without pyarrow
-            try:
-                import pyarrow as pa
-
-                arrow_arr = pa.array(
-                    self.values,
-                    type=pa.string(),
-                    mask=None if self.valid.all() else ~self.valid,
-                )
-                encoded = arrow_arr.dictionary_encode()
-                codes = (
-                    encoded.indices.fill_null(-1)
-                    .to_numpy(zero_copy_only=False)
-                    .astype(np.int64)
-                )
-                uniques = encoded.dictionary.to_numpy(zero_copy_only=False)
-                if uniques.dtype != object:
-                    uniques = uniques.astype(object)
-                out = (codes, uniques)
-                self._cache["dict_encode"] = out
-                return out
-            except ImportError:
-                pass
-            except pa.lib.ArrowException:
-                # backing values that aren't str (mixed object arrays,
-                # numeric values under a STRING ctype): the numpy path
-                # below stringifies them
-                pass
-        vals = self.values[self.valid]
-        if self.ctype == ColumnType.STRING:
-            vals = vals.astype(str)
-        uniques, inv = np.unique(vals, return_inverse=True)
-        codes = np.full(len(self.values), -1, dtype=np.int64)
-        codes[self.valid] = inv
-        out = (codes, uniques)
-        self._cache["dict_encode"] = out
-        return out
+        else:
+            cached = compute(col)
+        col._cache[key] = cached
+    return cached
 
 
 def gather_with_null(lut: np.ndarray, codes: np.ndarray, null_value) -> np.ndarray:
