@@ -1,6 +1,7 @@
-"""ColumnProfiler: full single-column profiles in exactly three scans.
+"""ColumnProfiler: full single-column profiles in AT MOST three scans.
 
-reference: profiles/ColumnProfiler.scala:54-669. Pass structure:
+reference: profiles/ColumnProfiler.scala:54-669. The reference's pass
+structure is:
   1. Size + per-column Completeness + ApproxCountDistinct (+ DataType for
      strings) — ONE fused device pass;
   2. numeric columns (schema-numeric or inferred-numeric strings, cast
@@ -9,7 +10,16 @@ reference: profiles/ColumnProfiler.scala:54-669. Pass structure:
      quantile sketches share it);
   3. exact histograms for low-cardinality string/bool columns — one
      group-by pass.
-"""
+
+Pass-budget improvement over the reference: a SCHEMA-numeric column's
+pass-2 analyzer set does not depend on pass-1 results (only
+inferred-numeric STRING columns need the post-inference cast), so its
+numeric statistics fuse into pass 1. Pass 2 then runs only for the
+string-cast columns — and, under column pruning, decodes ONLY those
+columns from a streaming source. A table with no numeric-looking string
+columns profiles in 2 scans; the reference's 3 is the ceiling either
+way (the reference itself always pays 3:
+ColumnProfiler.scala:103-153)."""
 
 from __future__ import annotations
 
@@ -43,6 +53,19 @@ from deequ_tpu.runners.analysis_runner import AnalysisRunner
 DEFAULT_CARDINALITY_THRESHOLD = 120
 
 _PERCENTILES = tuple(i / 100 for i in range(1, 101))
+
+
+def _numeric_stat_analyzers(name: str) -> List:
+    """The numeric-statistics bundle of the reference's pass 2
+    (ColumnProfiler.scala:219-235)."""
+    return [
+        Minimum(name),
+        Maximum(name),
+        Mean(name),
+        StandardDeviation(name),
+        Sum(name),
+        ApproxQuantiles(name, _PERCENTILES),
+    ]
 
 
 @dataclass
@@ -84,69 +107,96 @@ class ColumnProfiler:
             data.column(name)  # raises NoSuchColumnException early
 
         # ---- Pass 1 (reference: :103-126) --------------------------------
+        # Schema-numeric columns also get their full numeric statistics
+        # HERE: their pass-2 analyzer choice never depends on pass-1
+        # inference, so fusing them saves a whole scan (see module
+        # docstring). Only inferred-numeric strings still need pass 2.
+        # NOTE for repository reuse: the analyzer-per-pass assignment
+        # changed when this fusion landed, so a key saved by an older
+        # version misses the numeric metrics — reuse still works
+        # analyzer-by-analyzer unless fail_if_results_missing demands
+        # completeness.
+        may_need_pass2 = any(
+            data.column(name).ctype == ColumnType.STRING for name in relevant
+        )
+
+        def _with_repository(builder):
+            if metrics_repository is not None:
+                builder = builder.use_repository(metrics_repository)
+                if reuse_existing_results_for_key is not None:
+                    builder = builder.reuse_existing_results_for_key(
+                        reuse_existing_results_for_key, fail_if_results_missing
+                    )
+                if save_in_metrics_repository_using_key is not None:
+                    builder = builder.save_or_append_result(
+                        save_in_metrics_repository_using_key
+                    )
+            return builder
+
+        total_passes = 3 if may_need_pass2 else 2
         if print_status_updates:
-            print("### PROFILING: Computing generic column statistics in pass (1/3)...")
+            print(
+                "### PROFILING: Computing generic column statistics in "
+                f"pass (1/{total_passes})..."
+            )
         analyzers_pass1 = [Size()]
         for name in relevant:
             analyzers_pass1.append(Completeness(name))
             analyzers_pass1.append(ApproxCountDistinct(name))
-            if data.column(name).ctype == ColumnType.STRING:
+            ctype = data.column(name).ctype
+            if ctype == ColumnType.STRING:
                 analyzers_pass1.append(DataType(name))
+            elif ctype.is_numeric:
+                analyzers_pass1.extend(_numeric_stat_analyzers(name))
 
-        builder = (
+        results_pass1 = _with_repository(
             AnalysisRunner.on_data(data)
             .add_analyzers(analyzers_pass1)
             .with_engine(engine, mesh)
-        )
-        if metrics_repository is not None:
-            builder = builder.use_repository(metrics_repository)
-            if reuse_existing_results_for_key is not None:
-                builder = builder.reuse_existing_results_for_key(
-                    reuse_existing_results_for_key, fail_if_results_missing
-                )
-            if save_in_metrics_repository_using_key is not None:
-                builder = builder.save_or_append_result(
-                    save_in_metrics_repository_using_key
-                )
-        results_pass1 = builder.run()
+        ).run()
 
         generic_stats = _extract_generic_statistics(relevant, data, results_pass1)
 
         # ---- Pass 2 (reference: :128-153, cast at :399-417) --------------
-        if print_status_updates:
-            print("### PROFILING: Computing numeric column statistics in pass (2/3)...")
-        casted_data = _cast_numeric_string_columns(relevant, data, generic_stats)
+        # runs ONLY for inferred-numeric STRING columns, which need the
+        # post-inference cast; schema-numeric stats came from pass 1
         numeric_columns = [
             name
             for name in relevant
             if generic_stats.type_of(name)
             in (DataTypeInstances.INTEGRAL, DataTypeInstances.FRACTIONAL)
         ]
+        cast_columns = [
+            name for name in numeric_columns if name in generic_stats.inferred_types
+        ]
         analyzers_pass2 = []
-        for name in numeric_columns:
-            analyzers_pass2.extend(
-                [
-                    Minimum(name),
-                    Maximum(name),
-                    Mean(name),
-                    StandardDeviation(name),
-                    Sum(name),
-                    ApproxQuantiles(name, _PERCENTILES),
-                ]
+        for name in cast_columns:
+            analyzers_pass2.extend(_numeric_stat_analyzers(name))
+        combined = results_pass1
+        if analyzers_pass2:
+            if print_status_updates:
+                print(
+                    "### PROFILING: Computing numeric column statistics "
+                    f"in pass (2/{total_passes})..."
+                )
+            casted_data = _cast_numeric_string_columns(
+                cast_columns, data, generic_stats
             )
-        results_pass2 = (
-            AnalysisRunner.on_data(casted_data)
-            .add_analyzers(analyzers_pass2)
-            .with_engine(engine, mesh)
-            .run()
-            if analyzers_pass2
-            else None
-        )
-        numeric_stats = _extract_numeric_statistics(numeric_columns, results_pass2)
+            # same repository options as every other pass
+            # (reference: ColumnProfiler.scala:128-153 threads them through)
+            combined = combined + _with_repository(
+                AnalysisRunner.on_data(casted_data)
+                .add_analyzers(analyzers_pass2)
+                .with_engine(engine, mesh)
+            ).run()
+        numeric_stats = _extract_numeric_statistics(combined)
 
         # ---- Pass 3 (reference: :487-565) --------------------------------
         if print_status_updates:
-            print("### PROFILING: Computing histograms of low-cardinality columns in pass (3/3)...")
+            print(
+                "### PROFILING: Computing histograms of low-cardinality "
+                f"columns in pass ({total_passes}/{total_passes})..."
+            )
         target_columns = _find_target_columns_for_histograms(
             data, generic_stats, low_cardinality_histogram_threshold
         )
@@ -204,15 +254,11 @@ def _extract_generic_statistics(
 def _cast_numeric_string_columns(
     columns: Sequence[str], data: Table, stats: GenericColumnStatistics
 ) -> Table:
-    """String columns inferred Integral/Fractional are cast for pass 2
-    (reference: ColumnProfiler.scala:329-339, 399-417). On a streaming
-    source the cast is a lazy per-batch transform."""
-    to_cast = [
-        name
-        for name in columns
-        if stats.inferred_types.get(name)
-        in (DataTypeInstances.INTEGRAL, DataTypeInstances.FRACTIONAL)
-    ]
+    """Cast the given inferred-numeric string columns for pass 2
+    (reference: ColumnProfiler.scala:329-339, 399-417); the caller passes
+    exactly the columns whose inferred type is Integral/Fractional. On a
+    streaming source the cast is a lazy per-batch transform."""
+    to_cast = list(columns)
     if not to_cast:
         return data
 
@@ -247,10 +293,8 @@ class NumericColumnStatistics:
     approx_percentiles: Dict[str, List[float]] = field(default_factory=dict)
 
 
-def _extract_numeric_statistics(columns, results) -> NumericColumnStatistics:
+def _extract_numeric_statistics(results) -> NumericColumnStatistics:
     stats = NumericColumnStatistics()
-    if results is None:
-        return stats
     for analyzer, metric in results.metric_map.items():
         if not metric.value.is_success:
             continue

@@ -42,13 +42,67 @@ def example_table(n=120):
 
 
 class TestColumnProfiler:
-    def test_three_passes(self):
+    def test_pass_budget(self):
+        # the reference always pays 3 scans; ours pays 3 only when a
+        # string column must be cast after inference (amountStr here):
+        # pass1 fused scan (incl. schema-numeric stats) + pass2 for the
+        # cast column only + pass3 histogram group pass
         data = example_table()
         with runtime.monitored() as stats:
             profiles = ColumnProfilerRunner.on_data(data).run()
-        # pass1 fused scan + pass2 fused scan + pass3 histogram group pass
         assert stats.jobs == 3
         assert profiles.num_records == 120
+
+    def test_repository_reuse_covers_both_passes(self):
+        """Every pass threads the repository options (the reference does
+        too, ColumnProfiler.scala:128-153): a saved key holds metrics
+        from pass 1 AND the cast pass, and a strict reuse-run against it
+        recomputes nothing."""
+        from deequ_tpu.repository.base import ResultKey
+        from deequ_tpu.repository.memory import InMemoryMetricsRepository
+
+        data = example_table()
+        repo = InMemoryMetricsRepository()
+        key = ResultKey(1234, {"run": "a"})
+        first = (
+            ColumnProfilerRunner.on_data(data)
+            .use_repository(repo)
+            .save_or_append_result(key)
+            .run()
+        )
+        # amountStr is an inferred-numeric STRING column -> its stats come
+        # from the cast pass and must have been saved too
+        saved = repo.load_by_key(key)
+        assert any(
+            getattr(a, "column", None) == "amountStr" and a.name == "Mean"
+            for a in saved.metric_map
+        )
+        with runtime.monitored() as stats:
+            second = (
+                ColumnProfilerRunner.on_data(data)
+                .use_repository(repo)
+                .reuse_existing_results_for_key(key, fail_if_results_missing=True)
+                .run()
+            )
+        assert stats.device_launches == 0  # everything served from the repo
+        assert second.profiles["amountStr"].mean == first.profiles["amountStr"].mean
+        assert second.profiles["id"].mean == first.profiles["id"].mean
+
+    def test_two_passes_without_numeric_strings(self):
+        # no inferred-numeric string columns -> pass 2 vanishes entirely
+        data = Table.from_pydict(
+            {
+                "id": list(range(50)),
+                "score": [float(i) for i in range(50)],
+                "status": [["a", "b"][i % 2] for i in range(50)],
+            }
+        )
+        with runtime.monitored() as stats:
+            profiles = ColumnProfilerRunner.on_data(data).run()
+        assert stats.jobs == 2
+        # schema-numeric stats still fully populated from pass 1
+        assert profiles.profiles["id"].mean == pytest.approx(24.5)
+        assert profiles.profiles["score"].maximum == 49.0
 
     def test_profile_contents(self):
         data = example_table()
