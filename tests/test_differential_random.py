@@ -1,0 +1,183 @@
+"""Randomized differential testing: the same randomized analysis must
+produce identical metrics through every execution path — single-device
+fused, 8-device mesh, and each placement mode. Catches divergence the
+hand-written parity tests' fixed shapes can miss (odd null densities,
+degenerate columns, empty filters, constant values)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Completeness,
+    Compliance,
+    CountDistinct,
+    DataType,
+    Distinctness,
+    Entropy,
+    Histogram,
+    Maximum,
+    Mean,
+    Minimum,
+    PatternMatch,
+    Size,
+    StandardDeviation,
+    Sum,
+    Uniqueness,
+)
+from deequ_tpu.analyzers.sketch import ApproxQuantile
+from deequ_tpu.data.table import ColumnType, Table
+from deequ_tpu.parallel.distributed import data_mesh
+from deequ_tpu.runners.analysis_runner import AnalysisRunner
+
+N_TRIALS = 12
+
+
+def random_table(rng: np.random.Generator) -> Table:
+    n = int(rng.integers(1, 5000))
+    null_density = float(rng.choice([0.0, 0.02, 0.5, 0.97]))
+    x = rng.normal(rng.uniform(-100, 100), rng.uniform(0.0, 50.0), n)
+    x[rng.random(n) < null_density] = np.nan
+    cardinality = int(rng.choice([1, 2, 37, 4000]))
+    pool = np.array(
+        ["", "x", "-3", "7.5", "true", "word word", "ünïcodé", "it's"][
+            : max(1, min(8, cardinality))
+        ]
+        + [f"v{i}" for i in range(max(0, cardinality - 8))],
+        dtype=object,
+    )
+    s = pool[rng.integers(0, len(pool), n)]
+    s[rng.random(n) < null_density] = None
+    g = rng.integers(0, max(1, cardinality), n)
+    return Table.from_pydict(
+        {"x": list(x), "s": list(s), "g": [int(v) for v in g]},
+        types={"x": ColumnType.DOUBLE, "s": ColumnType.STRING, "g": ColumnType.LONG},
+    )
+
+
+def random_analyzers(rng: np.random.Generator):
+    pool = [
+        Size(),
+        Size(where="g > 1"),
+        Completeness("x"),
+        Completeness("s", where="g >= 0"),
+        Compliance("pos", "x > 0"),
+        Compliance("never", "x > 1e12"),
+        PatternMatch("s", r"^v\d+$"),
+        Mean("x"),
+        Minimum("x"),
+        Maximum("x"),
+        Sum("x"),
+        StandardDeviation("x"),
+        DataType("s"),
+        ApproxCountDistinct("g"),
+        ApproxCountDistinct("s"),
+        ApproxQuantile("x", 0.5),
+        Uniqueness(("g",)),
+        Distinctness(("s",)),
+        CountDistinct(("g", "s")),
+        Entropy("g"),
+        Histogram("g", max_detail_bins=10),
+    ]
+    k = int(rng.integers(3, len(pool) + 1))
+    idx = rng.choice(len(pool), size=k, replace=False)
+    return [pool[i] for i in idx]
+
+
+def metric_snapshot(ctx, analyzers):
+    out = {}
+    for analyzer in analyzers:
+        v = ctx.metric_map[analyzer].value
+        if v.is_failure:
+            out[repr(analyzer)] = ("FAIL", type(v.exception).__name__)
+        else:
+            value = v.get()
+            if hasattr(value, "values"):  # Distribution
+                value = tuple(sorted((k, dv.absolute) for k, dv in value.values.items()))
+            out[repr(analyzer)] = ("OK", value)
+    return out
+
+
+@pytest.mark.parametrize("seed", range(N_TRIALS))
+def test_engines_agree_on_random_input(seed):
+    rng = np.random.default_rng(1000 + seed)
+    table = random_table(rng)
+    analyzers = random_analyzers(rng)
+
+    single = metric_snapshot(
+        AnalysisRunner.do_analysis_run(table, analyzers, engine="single"), analyzers
+    )
+    mesh = metric_snapshot(
+        AnalysisRunner.do_analysis_run(
+            table, analyzers, engine="distributed", mesh=data_mesh()
+        ),
+        analyzers,
+    )
+
+    assert single.keys() == mesh.keys()
+    for key in single:
+        s_status, s_val = single[key]
+        m_status, m_val = mesh[key]
+        assert s_status == m_status, (key, single[key], mesh[key])
+        if s_status == "FAIL":
+            # same failure CLASS on both engines
+            assert s_val == m_val, key
+        elif key.startswith("ApproxQuantile"):
+            # sketch randomization differs across shard splits: both
+            # values are within rank error of the truth, so they agree
+            # loosely, not bit-for-bit
+            assert m_val == pytest.approx(s_val, rel=0.25, abs=2.0), (
+                key,
+                single[key],
+                mesh[key],
+            )
+        elif isinstance(s_val, float):
+            assert m_val == pytest.approx(s_val, rel=1e-9, abs=1e-12), (
+                key,
+                single[key],
+                mesh[key],
+            )
+        else:
+            assert s_val == m_val, key
+
+
+@pytest.mark.parametrize("seed", range(0, N_TRIALS, 3))
+def test_placements_agree_on_random_input(seed, monkeypatch):
+    rng = np.random.default_rng(2000 + seed)
+    table = random_table(rng)
+    analyzers = random_analyzers(rng)
+
+    snaps = {}
+    for placement in ("host", "host-discrete", "device"):
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+        snaps[placement] = metric_snapshot(
+            AnalysisRunner.do_analysis_run(table, analyzers, engine="single"),
+            analyzers,
+        )
+    base = snaps["host"]
+    for placement in ("host-discrete", "device"):
+        other = snaps[placement]
+        for key in base:
+            b_status, b_val = base[key]
+            o_status, o_val = other[key]
+            assert b_status == o_status, (placement, key, base[key], other[key])
+            if b_status != "OK":
+                assert b_val == o_val, (placement, key)
+            elif key.startswith("ApproxQuantile"):
+                # host and device sketch paths decimate with different
+                # per-batch structure: equal within rank error, not bits
+                # (abs=2.0 keeps the bound meaningful near-zero medians,
+                # same as the engine test above)
+                assert o_val == pytest.approx(b_val, rel=0.25, abs=2.0), (
+                    placement,
+                    key,
+                )
+            elif isinstance(b_val, float):
+                assert o_val == pytest.approx(b_val, rel=1e-9, abs=1e-12), (
+                    placement,
+                    key,
+                )
+            else:
+                assert b_val == o_val, (placement, key)

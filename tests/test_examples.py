@@ -16,7 +16,8 @@ EXAMPLES = sorted(p.name for p in EXAMPLES_DIR.glob("*_example.py"))
 
 def test_examples_inventory_matches_reference():
     # the reference ships 7 runnable examples + utils/entities; we port all
-    # of them and add two TPU-native extras (mesh + streaming parquet)
+    # of them and add three TPU-native extras (mesh, streaming parquet,
+    # high-cardinality spill)
     assert {
         "basic_example.py",
         "metrics_repository_example.py",
@@ -27,6 +28,7 @@ def test_examples_inventory_matches_reference():
         "update_metrics_on_partitioned_data_example.py",
         "distributed_mesh_example.py",
         "streaming_parquet_example.py",
+        "high_cardinality_spill_example.py",
     } <= set(EXAMPLES)
 
 
