@@ -26,11 +26,18 @@ _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
-def _cache_dirs():
-    """Candidate build dirs: the package itself, then a PER-USER 0700
-    cache — never a shared world-writable path, so no other user can
-    plant a library where we would dlopen it."""
-    yield os.path.dirname(_SOURCE)
+def per_user_cache_dir() -> Optional[str]:
+    """PER-USER 0700 cache dir — never a shared world-writable path, so
+    no other user can plant files where we would read them. Shared by
+    the native-library build and the placement cache. Overridable via
+    DEEQU_TPU_CACHE_DIR (tests point it at a tmp dir)."""
+    override = os.environ.get("DEEQU_TPU_CACHE_DIR")
+    if override:
+        try:
+            os.makedirs(override, mode=0o700, exist_ok=True)
+            return override
+        except OSError:
+            return None
     try:
         uid = os.getuid()
     except AttributeError:  # non-posix
@@ -38,10 +45,19 @@ def _cache_dirs():
     user_dir = os.path.join(tempfile.gettempdir(), f"deequ_tpu_native_{uid}")
     try:
         os.makedirs(user_dir, mode=0o700, exist_ok=True)
-        if os.stat(user_dir).st_uid == os.getuid():
-            yield user_dir
+        if uid == "u" or os.stat(user_dir).st_uid == uid:
+            return user_dir
     except OSError:
         pass
+    return None
+
+
+def _cache_dirs():
+    """Candidate build dirs: the package itself, then the per-user cache."""
+    yield os.path.dirname(_SOURCE)
+    user_dir = per_user_cache_dir()
+    if user_dir is not None:
+        yield user_dir
 
 
 def _build_library() -> Optional[str]:

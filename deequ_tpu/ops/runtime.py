@@ -140,8 +140,12 @@ def placement_mode() -> str:
                         reduction code; the device program is skipped
 
     The scheduler analogue of Spark's map-side combine decision, decided
-    by a one-shot synchronized bandwidth probe per process. Override with
-    DEEQU_TPU_PLACEMENT=device|host-discrete|host|auto ('host' = host-all).
+    by a synchronized bandwidth probe whose measurement is cached on disk
+    per (platform, device kind) with a TTL (PLACEMENT_CACHE_TTL_S) — on
+    slow tunnels the probe costs seconds of startup per process, so only
+    the first process in a week pays it. Override with
+    DEEQU_TPU_PLACEMENT=device|host-discrete|host|auto ('host' =
+    host-all); delete <cache dir>/placement.json to force a re-probe.
     """
     global _PLACEMENT_CACHE
     import os
@@ -154,11 +158,15 @@ def placement_mode() -> str:
     if env == "host-discrete":
         return "host-discrete"
     if _PLACEMENT_CACHE is None:
-        try:
-            bandwidth = measure_device_bandwidth()
-        except Exception:  # noqa: BLE001 - no device at all -> host
-            _PLACEMENT_CACHE = "host-all"
-            return _PLACEMENT_CACHE
+        bandwidth = _load_bandwidth_from_disk()
+        if bandwidth is None:
+            try:
+                bandwidth = measure_device_bandwidth()
+            except Exception:  # noqa: BLE001 - no device at all -> host
+                _PLACEMENT_CACHE = "host-all"
+                return _PLACEMENT_CACHE
+            _save_bandwidth_to_disk(bandwidth)
+        # classify at use time, so cached probes survive threshold tuning
         if bandwidth >= PLACEMENT_DEVICE_ALL_BANDWIDTH:
             _PLACEMENT_CACHE = "device"
         elif bandwidth >= PLACEMENT_BANDWIDTH_FLOOR:
@@ -166,6 +174,89 @@ def placement_mode() -> str:
         else:
             _PLACEMENT_CACHE = "host-all"
     return _PLACEMENT_CACHE
+
+
+# a cached probe is trusted this long; after that, re-measure (links can
+# change between sessions even for the same device kind)
+PLACEMENT_CACHE_TTL_S = 7 * 24 * 3600
+
+
+def _platform_key() -> Optional[str]:
+    """Identity of the attached backend — the cache key. Bandwidth is a
+    property of the platform/device pairing, not of the process."""
+    try:
+        device = jax.devices()[0]
+        return f"{device.platform}:{getattr(device, 'device_kind', '?')}"
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _placement_cache_path() -> Optional[str]:
+    import os
+
+    from deequ_tpu.ops.native import per_user_cache_dir
+
+    directory = per_user_cache_dir()
+    if directory is None:
+        return None
+    return os.path.join(directory, "placement.json")
+
+
+def _load_bandwidth_from_disk() -> Optional[float]:
+    """The probe costs seconds of real time on slow tunnels (two device
+    compiles + synchronized fetches), so the MEASURED BANDWIDTH is
+    cached per (platform, device kind) with a TTL. Delete the file (or
+    set DEEQU_TPU_PLACEMENT) to force a re-probe."""
+    import json
+    import os
+
+    path = _placement_cache_path()
+    key = _platform_key()
+    if path is None or key is None or not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            return None
+        entry = data.get(key)
+        if not isinstance(entry, dict):
+            return None
+        bandwidth = entry.get("bandwidth")
+        ts = entry.get("ts", 0)
+        if not isinstance(bandwidth, (int, float)) or bandwidth <= 0:
+            return None
+        if time.time() - float(ts) > PLACEMENT_CACHE_TTL_S:
+            return None
+        return float(bandwidth)
+    except (OSError, ValueError, TypeError):
+        return None
+
+
+def _save_bandwidth_to_disk(bandwidth: float) -> None:
+    import json
+    import os
+
+    from deequ_tpu.core.fileio import write_text_output
+
+    path = _placement_cache_path()
+    key = _platform_key()
+    if path is None or key is None:
+        return
+    data = {}
+    try:
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                loaded = json.load(f)
+            if isinstance(loaded, dict):
+                data = loaded
+    except (OSError, ValueError):
+        data = {}
+    data[key] = {"bandwidth": float(bandwidth), "ts": time.time()}
+    try:
+        write_text_output(path, json.dumps(data), overwrite=True)
+    except OSError:
+        pass
 
 
 @dataclass

@@ -9,8 +9,13 @@ Must run before jax is imported anywhere.
 """
 
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# isolate the per-user on-disk caches (placement probe results): tests
+# must neither read a developer's production cache nor overwrite it
+os.environ["DEEQU_TPU_CACHE_DIR"] = tempfile.mkdtemp(prefix="deequ_tpu_test_cache_")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -151,3 +151,53 @@ def test_host_all_runs_everything_without_device(mixed_table, monkeypatch):
     assert all(r.error is None for r in results)
     assert stats.device_passes == 1
     assert stats.device_launches == 0
+
+
+class TestPlacementDiskCache:
+    """The bandwidth probe's measurement persists per (platform, device
+    kind) with a TTL; corrupt or foreign cache contents must never crash
+    placement_mode."""
+
+    def _fresh(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("DEEQU_TPU_CACHE_DIR", str(tmp_path))
+        monkeypatch.delenv("DEEQU_TPU_PLACEMENT", raising=False)
+        monkeypatch.setattr(runtime, "_PLACEMENT_CACHE", None)
+
+    def test_round_trip(self, monkeypatch, tmp_path):
+        self._fresh(monkeypatch, tmp_path)
+        runtime._save_bandwidth_to_disk(123456789.0)
+        assert runtime._load_bandwidth_from_disk() == 123456789.0
+
+    def test_probe_skipped_when_cached(self, monkeypatch, tmp_path):
+        self._fresh(monkeypatch, tmp_path)
+        runtime._save_bandwidth_to_disk(5e9)  # fast link -> device
+        def boom(*a, **k):
+            raise AssertionError("probe must not run when cached")
+        monkeypatch.setattr(runtime, "measure_device_bandwidth", boom)
+        assert runtime.placement_mode() == "device"
+
+    def test_expired_entry_reprobes(self, monkeypatch, tmp_path):
+        self._fresh(monkeypatch, tmp_path)
+        runtime._save_bandwidth_to_disk(5e9)
+        monkeypatch.setattr(
+            runtime.time, "time",
+            lambda base=runtime.time.time(): base + runtime.PLACEMENT_CACHE_TTL_S + 1,
+        )
+        assert runtime._load_bandwidth_from_disk() is None
+
+    @pytest.mark.parametrize(
+        "content", ["null", "[\"device\"]", "{\"x\": \"y\"", "{\"a\": 1}",
+                    '{"cpu:cpu": {"bandwidth": -5, "ts": 0}}']
+    )
+    def test_corrupt_cache_is_ignored(self, monkeypatch, tmp_path, content):
+        self._fresh(monkeypatch, tmp_path)
+        (tmp_path / "placement.json").write_text(content)
+        assert runtime._load_bandwidth_from_disk() is None
+        # and saving over garbage works
+        runtime._save_bandwidth_to_disk(1e6)
+        assert runtime._load_bandwidth_from_disk() == 1e6
+
+    def test_classification_uses_current_thresholds(self, monkeypatch, tmp_path):
+        self._fresh(monkeypatch, tmp_path)
+        runtime._save_bandwidth_to_disk(500e6)  # mid-speed link
+        assert runtime.placement_mode() == "host-discrete"
