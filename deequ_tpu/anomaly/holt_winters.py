@@ -101,7 +101,13 @@ class HoltWinters(AnomalyDetectionStrategy):
         (reference: HoltWinters.scala:138-174)."""
         from scipy.optimize import minimize
 
-        series = jnp.asarray(training, dtype=jnp.float64)
+        from deequ_tpu.ops import runtime
+
+        # the engine's compute dtype: float64 with x64, float32 on bare
+        # TPU engines — requesting f64 there only produces truncation
+        # warnings, not precision
+        dtype = runtime.compute_dtype()
+        series = jnp.asarray(training, dtype=dtype)
 
         def rss(params_np: np.ndarray):
             _, residuals = _holt_winters_fit(
@@ -112,7 +118,7 @@ class HoltWinters(AnomalyDetectionStrategy):
         value_and_grad = jax.value_and_grad(lambda p: rss(p))
 
         def objective(p):
-            value, grad = value_and_grad(jnp.asarray(p, dtype=jnp.float64))
+            value, grad = value_and_grad(jnp.asarray(p, dtype=dtype))
             return float(value), np.asarray(grad, dtype=np.float64)
 
         result = minimize(
