@@ -121,12 +121,22 @@ class HoltWinters(AnomalyDetectionStrategy):
             value, grad = value_and_grad(jnp.asarray(p, dtype=dtype))
             return float(value), np.asarray(grad, dtype=np.float64)
 
+        # scipy's default ftol/gtol assume f64-accurate objectives; under
+        # an f32 engine the evaluation noise (~1e-7 relative) would make
+        # the line search terminate abnormally, so loosen the tolerances
+        # to sit above that noise floor
+        options = (
+            {"ftol": 1e-6, "gtol": 1e-4}
+            if np.dtype(dtype) == np.float32
+            else {}
+        )
         result = minimize(
             objective,
             x0=np.array([0.3, 0.1, 0.1]),
             jac=True,
             method="L-BFGS-B",
             bounds=[(0.0, 1.0)] * 3,
+            options=options,
         )
         return result.x
 
