@@ -134,6 +134,17 @@ class ApproxCountDistinct(ScanShareableAnalyzer):
             masked_rank = np.where(where, packed & 0x3F, 0)
             np.maximum.at(registers, np.asarray(packed >> 6), masked_rank)
             return {"registers": registers}
+        from deequ_tpu.ops import pallas_kernels
+
+        if pallas_kernels.shape_supported(
+            int(packed.shape[0])
+        ) and pallas_kernels.usable():
+            # pallas path: XLA serializes the 512-register scatter-max on
+            # TPU; the blockwise one-hot kernel keeps it on the VPU
+            masked_codes = xp.where(xp.asarray(w), packed, 0)
+            return {
+                "registers": pallas_kernels.hll_register_max(masked_codes)
+            }
         idx = packed >> 6
         rank = packed & 0x3F
         masked_rank = xp.where(xp.asarray(w), rank, 0)

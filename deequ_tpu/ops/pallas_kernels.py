@@ -1,0 +1,103 @@
+"""Pallas TPU kernels for device ops XLA lowers poorly.
+
+The fused scan leaves almost everything to XLA (reductions fuse well on
+the MXU/VPU), with ONE exception: the HLL register update is a
+scatter-max into 512 registers, which XLA serializes on TPU. This
+kernel reformulates it as a blockwise one-hot compare + max reduction —
+pure VPU work, sequential-grid accumulation into the 512-register
+output (reference hot loop: catalyst/StatefulHyperloglogPlus.scala:86-115;
+kernel playbook: the repo's pallas guide).
+
+Used automatically on the TPU platform when shapes allow (row count a
+multiple of the 1024-row block); every caller falls back to the
+`.at[idx].max(rank)` XLA path otherwise, and interpret mode backs the
+CPU tests — results are identical by construction.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deequ_tpu.ops.sketches.hll import M as N_REGISTERS
+
+# the (8, N_REGISTERS) output tile assumes the register count is a lane
+# multiple; a precision change that breaks this must fail loudly, not
+# drop registers
+assert N_REGISTERS % 128 == 0, N_REGISTERS
+_BLOCK_ROWS = 8  # (8, 128) int32 tile -> 1024 codes per grid step
+_BLOCK = _BLOCK_ROWS * 128
+
+_USABLE: Optional[bool] = None
+
+
+def _kernel(codes_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    codes = codes_ref[:]  # (BLOCK_ROWS, 128) int32, masked rows carry 0
+    idx = codes >> 6
+    rank = codes & 0x3F
+    # one-hot compare against all 512 registers: (BR, 128, 512) VPU work.
+    # The per-sublane partial max keeps the output a clean (8, 512) tile
+    # (an in-kernel (512,) -> (4,128) reshape fails to lower on some
+    # mosaic builds); the final 8-way max is one tiny XLA op outside.
+    regs = jax.lax.broadcasted_iota(jnp.int32, (_BLOCK_ROWS, 128, N_REGISTERS), 2)
+    contrib = jnp.where(idx[:, :, None] == regs, rank[:, :, None], 0)
+    block_max = jnp.max(contrib, axis=1)  # (BLOCK_ROWS, 512)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros((_BLOCK_ROWS, N_REGISTERS), dtype=jnp.int32)
+
+    out_ref[:] = jnp.maximum(out_ref[:], block_max)
+
+
+def hll_register_max(codes, interpret: bool = False):
+    """Register-wise max over packed (idx << 6 | rank) codes.
+
+    `codes` length must be a multiple of 1024 (callers check
+    `shape_supported`); masked/invalid rows must carry code 0 (idx 0,
+    rank 0 — a no-op for the max)."""
+    from jax.experimental import pallas as pl
+
+    n = codes.shape[0]
+    grid = n // _BLOCK
+    codes2d = codes.reshape(grid * _BLOCK_ROWS, 128).astype(jnp.int32)
+    out = pl.pallas_call(
+        _kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, 128), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_BLOCK_ROWS, N_REGISTERS), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((_BLOCK_ROWS, N_REGISTERS), jnp.int32),
+        interpret=interpret,
+    )(codes2d)
+    return jnp.max(out, axis=0)
+
+
+def shape_supported(n: int) -> bool:
+    return n >= _BLOCK and n % _BLOCK == 0
+
+
+def usable() -> bool:
+    """True when the attached platform compiles and runs the kernel
+    (checked once with a tiny smoke input; any failure disables the
+    pallas path for the process — the XLA scatter path is always a
+    correct fallback)."""
+    global _USABLE
+    if _USABLE is None:
+        try:
+            if jax.devices()[0].platform != "tpu":
+                _USABLE = False
+            else:
+                smoke = jnp.zeros(_BLOCK, dtype=jnp.int32)
+                np.asarray(jax.jit(hll_register_max)(smoke))
+                _USABLE = True
+        except Exception:  # noqa: BLE001 - any compile/runtime failure
+            _USABLE = False
+    return _USABLE

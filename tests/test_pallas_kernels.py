@@ -1,0 +1,94 @@
+"""Pallas HLL register-max kernel: interpret-mode equivalence with the
+XLA scatter-max path (the CPU-side proof for the TPU kernel; on real
+TPU hardware `usable()` turns it on inside the fused scan)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deequ_tpu.ops import pallas_kernels
+from deequ_tpu.ops.sketches import hll
+
+
+def reference_registers(codes: np.ndarray) -> np.ndarray:
+    regs = np.zeros(pallas_kernels.N_REGISTERS, dtype=np.int32)
+    np.maximum.at(regs, codes >> 6, codes & 0x3F)
+    return regs
+
+
+def random_codes(rng, n):
+    idx = rng.integers(0, pallas_kernels.N_REGISTERS, n, dtype=np.int32)
+    rank = rng.integers(0, 57, n, dtype=np.int32)
+    return (idx << 6) | rank
+
+
+class TestShapeGate:
+    def test_supported_shapes(self):
+        assert pallas_kernels.shape_supported(1024)
+        assert pallas_kernels.shape_supported(1 << 22)
+        assert not pallas_kernels.shape_supported(8)
+        assert not pallas_kernels.shape_supported(1025)
+        assert not pallas_kernels.shape_supported(0)
+
+    def test_usable_is_false_on_cpu(self):
+        # the test platform is CPU: the pallas path must gate itself off
+        assert pallas_kernels.usable() is False
+
+
+class TestInterpretModeEquivalence:
+    @pytest.mark.parametrize("n", [1024, 4096, 1 << 15])
+    def test_random_codes(self, n):
+        rng = np.random.default_rng(n)
+        codes = random_codes(rng, n)
+        got = np.asarray(
+            pallas_kernels.hll_register_max(codes, interpret=True)
+        )
+        np.testing.assert_array_equal(got, reference_registers(codes))
+
+    def test_masked_rows_are_noops(self):
+        rng = np.random.default_rng(7)
+        codes = random_codes(rng, 2048)
+        codes[::3] = 0  # masked/invalid rows carry code 0
+        got = np.asarray(
+            pallas_kernels.hll_register_max(codes, interpret=True)
+        )
+        # masked rows must contribute nothing: equal to the registers of
+        # the UNMASKED rows alone
+        unmasked_only = codes[codes != 0]
+        pad = np.zeros(2048 - len(unmasked_only), dtype=np.int32)
+        np.testing.assert_array_equal(
+            got, reference_registers(np.concatenate([unmasked_only, pad]))
+        )
+
+    def test_all_zero(self):
+        got = np.asarray(
+            pallas_kernels.hll_register_max(
+                np.zeros(1024, dtype=np.int32), interpret=True
+            )
+        )
+        np.testing.assert_array_equal(got, np.zeros(512, dtype=np.int32))
+
+    def test_single_register_saturation(self):
+        codes = np.full(1024, (511 << 6) | 56, dtype=np.int32)
+        got = np.asarray(
+            pallas_kernels.hll_register_max(codes, interpret=True)
+        )
+        assert got[511] == 56
+        assert got[:511].sum() == 0
+
+    def test_matches_hll_pack_pipeline(self):
+        """End-to-end against the production packer: registers from the
+        pallas kernel == registers from the host fold for real values."""
+        rng = np.random.default_rng(0)
+        values = rng.integers(0, 5000, 4096)
+        valid = rng.random(4096) < 0.9
+        packed = hll.pack_codes(values, valid)
+        got = np.asarray(
+            pallas_kernels.hll_register_max(packed, interpret=True)
+        )
+        expected = np.zeros(hll.M, dtype=np.int32)
+        np.maximum.at(expected, packed >> 6, packed & 0x3F)
+        np.testing.assert_array_equal(got, expected)
+        # and the estimate built from them is the production estimate
+        assert hll.estimate(got) == hll.estimate(expected)
