@@ -23,7 +23,12 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = textwrap.dedent(
     """
+    import os
     import sys
+
+    # a tiny group cap so the Uniqueness state SPILLS on each host and
+    # the spilled-frequencies envelope crosses the real allgather
+    os.environ["DEEQU_TPU_MAX_GROUPS_IN_MEMORY"] = "200"
 
     import jax
 
@@ -43,20 +48,36 @@ WORKER = textwrap.dedent(
     from deequ_tpu.analyzers import (
         ApproxCountDistinct,
         Completeness,
+        CountDistinct,
         Maximum,
         Mean,
         Minimum,
         Size,
         StandardDeviation,
         Sum,
+        Uniqueness,
     )
-    from deequ_tpu.data.table import Table
+    from deequ_tpu.data.source import ParquetSource
     from deequ_tpu.parallel import multihost
 
     rng = np.random.default_rng(100 + rank)
     x = rng.normal(3.0, 2.0, 50_000)
     x[::7] = np.nan
-    table = Table.from_numpy({"x": x, "g": rng.integers(0, 1000, 50_000)})
+    arrays = {"x": x, "g": rng.integers(0, 1000, 50_000)}
+    # stream the partition from Parquet so the grouping fold actually
+    # exceeds the cap batch by batch (in-memory single-batch tables
+    # compute frequencies in one shot without the accumulator)
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = sys.argv[3] + f"/part{rank}.parquet"
+    pq.write_table(
+        pa.table({"x": pa.array(arrays["x"], mask=np.isnan(arrays["x"])),
+                  "g": pa.array(arrays["g"])}),
+        path,
+        row_group_size=5_000,
+    )
+    source = ParquetSource(path, batch_rows=5_000)
     analyzers = [
         Size(),
         Completeness("x"),
@@ -66,8 +87,10 @@ WORKER = textwrap.dedent(
         Maximum("x"),
         StandardDeviation("x"),
         ApproxCountDistinct("g"),
+        Uniqueness(("g",)),
+        CountDistinct(("g",)),
     ]
-    ctx = multihost.run_multihost_analysis(table, analyzers)
+    ctx = multihost.run_multihost_analysis(source, analyzers)
     out = {repr(a): ctx.metric_map[a].value.get() for a in analyzers}
     print("RESULT:" + json.dumps(out), flush=True)
     """
@@ -91,7 +114,7 @@ def test_two_process_multihost_analysis(tmp_path):
 
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker_path), str(rank), str(port)],
+            [sys.executable, str(worker_path), str(rank), str(port), str(tmp_path)],
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
@@ -126,16 +149,19 @@ def test_two_process_multihost_analysis(tmp_path):
     for key in results[0]:
         assert results[0][key] == pytest.approx(results[1][key], rel=1e-12), key
 
-    # ... equal to the whole-table (both partitions concatenated) run
+    # ... equal to the whole-table (both partitions concatenated) run,
+    # including the grouping metrics whose per-host states SPILLED
     from deequ_tpu.analyzers import (
         ApproxCountDistinct,
         Completeness,
+        CountDistinct,
         Maximum,
         Mean,
         Minimum,
         Size,
         StandardDeviation,
         Sum,
+        Uniqueness,
     )
     from deequ_tpu.data.table import Table
     from deequ_tpu.runners.analysis_runner import AnalysisRunner
@@ -158,6 +184,8 @@ def test_two_process_multihost_analysis(tmp_path):
         Maximum("x"),
         StandardDeviation("x"),
         ApproxCountDistinct("g"),
+        Uniqueness(("g",)),
+        CountDistinct(("g",)),
     ]
     ctx = AnalysisRunner.do_analysis_run(whole, analyzers)
     for analyzer in analyzers:
