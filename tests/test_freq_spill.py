@@ -177,6 +177,30 @@ def test_mutual_information_over_spilled_state(high_card_parquet):
     )
 
 
+def test_multi_column_spill_matches_in_memory(high_card_parquet):
+    """Spill routing hashes ALL key columns; a (near-unique, low-card)
+    pair must produce the same metrics as the in-memory path."""
+    grouping = [
+        Uniqueness(("id", "cat")),
+        CountDistinct(("id", "cat")),
+        UniqueValueRatio(("cat", "id")),  # declared order differs from sorted
+    ]
+    source = ParquetSource(high_card_parquet, batch_rows=1 << 14)
+    ctx_stream = AnalysisRunner.do_analysis_run(source, grouping, engine="single")
+    ctx_mem = AnalysisRunner.do_analysis_run(
+        Table.from_parquet(high_card_parquet), grouping, engine="single"
+    )
+    # the joint key is ~unique: the state must actually have spilled
+    state = compute_frequencies(
+        ParquetSource(high_card_parquet, batch_rows=1 << 14), ["cat", "id"]
+    )
+    assert isinstance(state, SpilledFrequencies)
+    for analyzer in grouping:
+        assert ctx_stream.metric_map[analyzer].value.get() == pytest.approx(
+            ctx_mem.metric_map[analyzer].value.get(), rel=1e-12
+        ), analyzer
+
+
 def test_spilled_merge_with_in_memory_partial():
     rng = np.random.default_rng(5)
     keys_a = np.array([f"k{i}" for i in range(30_000)], dtype=object)
