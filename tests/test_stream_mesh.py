@@ -134,6 +134,24 @@ def test_quantile_stream_mesh_within_rank_bound(parquet_path, in_memory):
     assert lo <= got <= hi
 
 
+def test_where_predicates_survive_column_pruning(parquet_path, in_memory):
+    """A where clause's referenced columns join the pruned read set even
+    when no analyzer consumes them directly; filtered metrics over the
+    streamed source equal the in-memory run."""
+    analyzers = [
+        Size(where="g >= 250"),
+        Mean("x", where="g >= 250"),
+        Completeness("x", where="g < 100"),
+    ]
+    source = ParquetSource(parquet_path, batch_rows=1 << 16)
+    ctx_stream = AnalysisRunner.do_analysis_run(source, analyzers, engine="single")
+    ctx_mem = AnalysisRunner.do_analysis_run(in_memory, analyzers, engine="single")
+    for analyzer in analyzers:
+        assert ctx_stream.metric_map[analyzer].value.get() == pytest.approx(
+            ctx_mem.metric_map[analyzer].value.get(), rel=1e-12
+        ), analyzer
+
+
 def test_stream_profile_equals_in_memory(parquet_path, in_memory):
     """Full ColumnProfiler over the streaming source == over the
     in-memory table (the parity spot-check backing the 100M-row bench
