@@ -151,6 +151,13 @@ class TestEntropyAndMI:
         h = value_of(t, Entropy("att1")).get()
         assert mi == pytest.approx(h, rel=1e-12)
 
+    def test_mi_of_column_with_itself_is_its_entropy(self):
+        """reference: AnalyzerTests.scala:159-170 — MI(X, X) == H(X)."""
+        t = get_df_full()
+        mi = value_of(t, MutualInformation("att1", "att1")).get()
+        h = value_of(t, Entropy("att1")).get()
+        assert mi == pytest.approx(h, rel=1e-12)
+
     def test_mi_requires_two_columns(self):
         v = value_of(
             get_df_with_numeric_values(), MutualInformation(["att1", "att2", "item"])
