@@ -226,12 +226,16 @@ class SpilledFrequencies(State):
             yield FrequenciesAndNumRows(self.columns, key_columns, counts, 0)
 
     def top_n(self, n: int) -> Tuple[List[np.ndarray], np.ndarray]:
-        """Exact global top-n groups by count: per-partition top-n, then
-        top-n of the union (each partition holds its keys' FULL counts)."""
+        """Exact global top-n groups by (count desc, key asc):
+        per-partition top-n, then top-n of the union (each partition
+        holds its keys' FULL counts; the deterministic tie-break matches
+        the in-memory path, analyzers/frequency.py:top_n_order)."""
+        from deequ_tpu.analyzers.frequency import top_n_order
+
         best_keys: List[List[np.ndarray]] = []
         best_counts: List[np.ndarray] = []
         for part in self.partitions():
-            order = np.argsort(part.counts, kind="stable")[::-1][:n]
+            order = top_n_order(part.key_columns[0], part.counts, n)
             best_keys.append([kc[order] for kc in part.key_columns])
             best_counts.append(part.counts[order])
         if not best_counts:
@@ -244,7 +248,7 @@ class SpilledFrequencies(State):
             np.concatenate([bk[j] for bk in best_keys])
             for j in range(len(self.columns))
         ]
-        order = np.argsort(counts, kind="stable")[::-1][:n]
+        order = top_n_order(keys[0], counts, n)
         return [kc[order] for kc in keys], counts[order]
 
     def merge(self, other) -> "SpilledFrequencies":

@@ -165,6 +165,30 @@ def _group_sum(
     return out_keys, grouped.to_numpy(dtype=np.int64)
 
 
+def top_n_order(keys: np.ndarray, counts: np.ndarray, n: int) -> np.ndarray:
+    """Indices of the top-n groups by (count desc, key asc) — the
+    deterministic tie-break shared by Histogram's in-memory selection
+    and SpilledFrequencies.top_n (the reference's rdd.top leaves tie
+    order partition-dependent; a total order keeps the detail-bin set
+    identical across execution paths)."""
+    counts = np.asarray(counts)
+    m = len(counts)
+    if m == 0 or n <= 0:
+        return np.array([], dtype=np.int64)
+    if m > n:
+        # preselect: everything with count >= the n-th largest count
+        # (boundary ties included), so the string work below runs over
+        # ~n candidates instead of every group
+        kth = np.partition(counts, m - n)[m - n]
+        cand = np.nonzero(counts >= kth)[0]
+    else:
+        cand = np.arange(m)
+    # U-dtype (not object) keys: numpy lexsort stays vectorized
+    cand_keys = np.asarray(keys)[cand].astype(str)
+    order = np.lexsort((cand_keys, -counts[cand]))[:n]
+    return cand[order]
+
+
 def _column_key_values(col) -> Tuple[np.ndarray, np.ndarray]:
     """(codes, uniques) with uniques as python-friendly scalars."""
     codes, uniques = col.dict_encode()

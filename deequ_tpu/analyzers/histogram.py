@@ -12,7 +12,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from deequ_tpu.analyzers.base import Analyzer, Preconditions
-from deequ_tpu.analyzers.frequency import FrequenciesAndNumRows
+from deequ_tpu.analyzers.frequency import FrequenciesAndNumRows, top_n_order
 from deequ_tpu.core.exceptions import IllegalAnalyzerParameterException, wrap_if_necessary
 from deequ_tpu.core.maybe import Failure, Success, Try
 from deequ_tpu.core.metrics import (
@@ -164,9 +164,11 @@ class Histogram(Analyzer):
                 top_keys, top_counts = state.top_n(self.max_detail_bins)
                 keys_arr, counts_arr = top_keys[0], top_counts
             else:
-                order = np.argsort(state.counts, kind="stable")[::-1][
-                    : self.max_detail_bins
-                ]
+                # (count desc, key asc): deterministic tie-break, see
+                # frequency.top_n_order
+                order = top_n_order(
+                    state.key_columns[0], state.counts, self.max_detail_bins
+                )
                 keys_arr = state.key_columns[0][order]
                 counts_arr = state.counts[order]
             details = {}

@@ -177,6 +177,42 @@ def test_mutual_information_over_spilled_state(high_card_parquet):
     )
 
 
+def test_histogram_top_n_tie_break_is_deterministic():
+    """(count desc, key asc): with max_detail_bins below the number of
+    tied groups, the selected detail set must be identical in-memory and
+    streamed/spilled (the reference's rdd.top leaves this partition-
+    dependent; we define it)."""
+    from deequ_tpu.analyzers.frequency import top_n_order
+
+    keys = np.array(["b", "d", "a", "c", "e"], dtype=object)
+    counts = np.array([2, 1, 2, 2, 1], dtype=np.int64)
+    order = top_n_order(keys, counts, 4)
+    assert list(keys[order]) == ["a", "b", "c", "d"]  # 2s by key, then 1s
+
+    # cross-path: all-tied counts, cap smaller than the group count
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import tempfile
+
+    n = 60_000  # all-unique -> every count ties at 1
+    ids = np.array([f"k{i:06d}" for i in range(n)], dtype=object)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = f"{tmp}/ties.parquet"
+        pq.write_table(
+            pa.table({"id": pa.array(list(ids))}), path, row_group_size=10_000
+        )
+        analyzer = Histogram("id", max_detail_bins=7)
+        mem = AnalysisRunner.do_analysis_run(
+            Table.from_parquet(path), [analyzer], engine="single"
+        ).metric_map[analyzer].value.get()
+        stream = AnalysisRunner.do_analysis_run(
+            ParquetSource(path, batch_rows=1 << 13), [analyzer], engine="single"
+        ).metric_map[analyzer].value.get()
+    assert list(mem.values) == list(stream.values) == [
+        f"k{i:06d}" for i in range(7)
+    ]
+
+
 def test_multi_column_spill_matches_in_memory(high_card_parquet):
     """Spill routing hashes ALL key columns; a (near-unique, low-card)
     pair must produce the same metrics as the in-memory path."""
