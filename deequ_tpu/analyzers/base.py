@@ -21,7 +21,6 @@ import numpy as np
 
 from deequ_tpu.core.exceptions import (
     EmptyStateException,
-    MetricCalculationException,
     NoColumnsSpecifiedException,
     NoSuchColumnException,
     NumberOfSpecifiedColumnsException,
@@ -29,7 +28,7 @@ from deequ_tpu.core.exceptions import (
     wrap_if_necessary,
 )
 from deequ_tpu.core.metrics import DoubleMetric, Entity, Metric
-from deequ_tpu.core.maybe import Failure, Success
+from deequ_tpu.core.maybe import Failure
 from deequ_tpu.analyzers.states import State
 from deequ_tpu.data.expr import Predicate
 from deequ_tpu.data.table import ColumnType, Table

@@ -229,8 +229,17 @@ class SpilledFrequencies(State):
         """Exact global top-n groups by (count desc, key asc):
         per-partition top-n, then top-n of the union (each partition
         holds its keys' FULL counts; the deterministic tie-break matches
-        the in-memory path, analyzers/frequency.py:top_n_order)."""
+        the in-memory path, analyzers/frequency.py:top_n_order).
+
+        SINGLE-COLUMN states only (the key-ascending tie-break is over
+        the first key column; Histogram — the one consumer — always
+        groups one column)."""
         from deequ_tpu.analyzers.frequency import top_n_order
+
+        assert len(self.columns) == 1, (
+            "top_n's deterministic tie-break is defined for single-column "
+            f"states, got {self.columns}"
+        )
 
         best_keys: List[List[np.ndarray]] = []
         best_counts: List[np.ndarray] = []

@@ -15,13 +15,11 @@ reference's null-safe outer join (GroupingAnalyzers.scala:128-148).
 
 from __future__ import annotations
 
-import math
-from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from deequ_tpu.analyzers.base import COUNT_COL, Analyzer, Preconditions, entity_from
+from deequ_tpu.analyzers.base import Preconditions, entity_from
 from deequ_tpu.analyzers.grouping import GroupingAnalyzer
 from deequ_tpu.analyzers.states import State
 from deequ_tpu.core.maybe import Success
@@ -170,23 +168,33 @@ def top_n_order(keys: np.ndarray, counts: np.ndarray, n: int) -> np.ndarray:
     deterministic tie-break shared by Histogram's in-memory selection
     and SpilledFrequencies.top_n (the reference's rdd.top leaves tie
     order partition-dependent; a total order keeps the detail-bin set
-    identical across execution paths)."""
+    identical across execution paths).
+
+    Groups strictly above the n-th count sort fully; the boundary tie
+    group only pays an O(|ties|) key partition for its n-fill smallest
+    keys, so an all-tied high-cardinality column never string-sorts
+    every group."""
     counts = np.asarray(counts)
     m = len(counts)
     if m == 0 or n <= 0:
         return np.array([], dtype=np.int64)
-    if m > n:
-        # preselect: everything with count >= the n-th largest count
-        # (boundary ties included), so the string work below runs over
-        # ~n candidates instead of every group
-        kth = np.partition(counts, m - n)[m - n]
-        cand = np.nonzero(counts >= kth)[0]
-    else:
-        cand = np.arange(m)
-    # U-dtype (not object) keys: numpy lexsort stays vectorized
-    cand_keys = np.asarray(keys)[cand].astype(str)
-    order = np.lexsort((cand_keys, -counts[cand]))[:n]
-    return cand[order]
+    if m <= n:
+        keys_u = np.asarray(keys).astype(str)  # U-dtype: vectorized sort
+        return np.lexsort((keys_u, -counts))
+    kth = np.partition(counts, m - n)[m - n]
+    above = np.nonzero(counts > kth)[0]
+    above_keys = np.asarray(keys)[above].astype(str)
+    above_order = np.lexsort((above_keys, -counts[above]))
+    n_fill = n - len(above)
+    if n_fill <= 0:
+        return above[above_order][:n]
+    tie = np.nonzero(counts == kth)[0]
+    tie_keys = np.asarray(keys)[tie].astype(str)
+    if len(tie) > n_fill:
+        part = np.argpartition(tie_keys, n_fill - 1)[:n_fill]
+        tie, tie_keys = tie[part], tie_keys[part]
+    fill = tie[np.argsort(tie_keys)]
+    return np.concatenate([above[above_order], fill])
 
 
 def _column_key_values(col) -> Tuple[np.ndarray, np.ndarray]:

@@ -14,7 +14,7 @@ import numpy as np
 from deequ_tpu.analyzers.base import Analyzer, Preconditions
 from deequ_tpu.analyzers.frequency import FrequenciesAndNumRows, top_n_order
 from deequ_tpu.core.exceptions import IllegalAnalyzerParameterException, wrap_if_necessary
-from deequ_tpu.core.maybe import Failure, Success, Try
+from deequ_tpu.core.maybe import Failure, Try
 from deequ_tpu.core.metrics import (
     Distribution,
     DistributionValue,

@@ -16,7 +16,7 @@ exactly nothing.
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -27,7 +27,6 @@ from deequ_tpu.analyzers.base import (
     ScanShareableAnalyzer,
     col_valid_spec,
     col_values_spec,
-    entity_from,
     render_where,
     where_key,
     where_spec,
@@ -53,7 +52,7 @@ from deequ_tpu.core.metrics import (
     HistogramMetric,
     Metric,
 )
-from deequ_tpu.data.table import Column, ColumnType, Table
+from deequ_tpu.data.table import ColumnType, Table
 
 
 def _f(xp, x):

@@ -11,8 +11,8 @@ reference: analyzers/ApproxQuantile.scala:49, ApproxQuantiles.scala:39).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -29,7 +29,7 @@ from deequ_tpu.analyzers.base import (
 from deequ_tpu.analyzers.states import DoubleValuedState, State
 from deequ_tpu.core.exceptions import IllegalAnalyzerParameterException
 from deequ_tpu.core.maybe import Success
-from deequ_tpu.core.metrics import DoubleMetric, Entity, KeyedDoubleMetric, Metric
+from deequ_tpu.core.metrics import DoubleMetric, KeyedDoubleMetric, Metric
 from deequ_tpu.data.table import Table
 from deequ_tpu.ops.sketches import hll
 from deequ_tpu.ops.sketches.kll import KLLSketch, k_for_error

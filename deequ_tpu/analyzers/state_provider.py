@@ -24,7 +24,7 @@ import hashlib
 import os
 import struct
 import threading
-from typing import TYPE_CHECKING, Dict, List, Optional
+from typing import TYPE_CHECKING, Dict, Optional
 
 import numpy as np
 

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from typing import Optional, TypeVar
+from typing import TypeVar
 
 S = TypeVar("S", bound="State")
 

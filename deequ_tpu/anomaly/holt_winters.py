@@ -11,8 +11,6 @@ ApproximateGradientFunction, autodiff gives the real thing.
 from __future__ import annotations
 
 import enum
-import math
-from dataclasses import dataclass
 from functools import partial
 from typing import List, Sequence, Tuple
 

@@ -9,8 +9,8 @@ Python default/keyword arguments).
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Union
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Set
 
 from deequ_tpu.analyzers import Patterns
 from deequ_tpu.analyzers.base import Analyzer
@@ -23,7 +23,6 @@ from deequ_tpu.constraints.constraint import (
     ConstraintResult,
     ConstraintStatus,
 )
-from deequ_tpu.core.metrics import Distribution
 
 
 class CheckLevel(enum.Enum):

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, Optional, Sequence
 
 from deequ_tpu.analyzers import (
     ApproxCountDistinct,
@@ -18,7 +18,6 @@ from deequ_tpu.analyzers import (
     Completeness,
     Compliance,
     Correlation,
-    CountDistinct,
     DataType,
     Distinctness,
     Entropy,

@@ -7,7 +7,7 @@ Pure data layer — no engine dependency.
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Generic, List, Sequence, TypeVar
 
 from deequ_tpu.core.maybe import Failure, Success, Try

@@ -16,7 +16,6 @@ CPU tests — results are identical by construction.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax

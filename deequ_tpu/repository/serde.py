@@ -17,7 +17,7 @@ writable only by this implementation and loadable only by it.
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 from deequ_tpu.analyzers import (
     ApproxCountDistinct,

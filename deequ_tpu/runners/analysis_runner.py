@@ -12,7 +12,7 @@ Pipeline (reference: runners/AnalysisRunner.scala:98-193):
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence
 
 from deequ_tpu.analyzers.base import Analyzer, Preconditions, ScanShareableAnalyzer
 from deequ_tpu.core.metrics import Metric

@@ -8,7 +8,7 @@ from __future__ import annotations
 import json
 from typing import TYPE_CHECKING, Dict, List, Optional, Sequence
 
-from deequ_tpu.core.metrics import DoubleMetric, Metric
+from deequ_tpu.core.metrics import Metric
 
 if TYPE_CHECKING:
     from deequ_tpu.analyzers.base import Analyzer

@@ -9,7 +9,6 @@ reference; generated code snippets use this framework's Python DSL.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
 from typing import List
 
 from deequ_tpu.analyzers.scan import DataTypeInstances

@@ -7,13 +7,12 @@ ConstraintSuggestionRunBuilder.scala:78-289.
 
 from __future__ import annotations
 
-import json
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
 from deequ_tpu.checks.check import Check, CheckLevel
 from deequ_tpu.data.table import Table
-from deequ_tpu.profiles.column_profile import ColumnProfile, ColumnProfiles
+from deequ_tpu.profiles.column_profile import ColumnProfile
 from deequ_tpu.profiles.column_profiler import (
     DEFAULT_CARDINALITY_THRESHOLD,
     ColumnProfiler,

@@ -8,11 +8,11 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass
-from typing import TYPE_CHECKING, Dict, List, Optional, Sequence
+from typing import TYPE_CHECKING, Dict, List
 
 from deequ_tpu.checks.check import Check, CheckResult, CheckStatus
 from deequ_tpu.core.metrics import Metric
-from deequ_tpu.runners.context import AnalyzerContext, sanitize_json_values
+from deequ_tpu.runners.context import AnalyzerContext
 
 if TYPE_CHECKING:
     from deequ_tpu.analyzers.base import Analyzer
