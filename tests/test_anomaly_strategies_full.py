@@ -214,6 +214,40 @@ class TestAnomalyDetectorPreprocessing:
         assert not ok.anomalies
 
 
+class TestDegenerateSeriesRobustness:
+    """No strategy may crash (beyond documented ValueErrors) or hang on
+    degenerate input: empty, single-point, constant, inf-scaled."""
+
+    SERIES = [
+        [],
+        [1.0],
+        [1.0, 1.0],
+        [float("inf")],
+        [0.0] * 5,
+    ]
+    INTERVALS = [(0, 0), (0, 100), (1, 2)]
+
+    @pytest.mark.parametrize(
+        "make",
+        [
+            lambda: SimpleThresholdStrategy(lower_bound=-1.0, upper_bound=1.0),
+            lambda: RateOfChangeStrategy(max_rate_increase=1.0, order=1),
+            lambda: RateOfChangeStrategy(max_rate_increase=1.0, order=3),
+            lambda: OnlineNormalStrategy(),
+            lambda: BatchNormalStrategy(),
+        ],
+        ids=["threshold", "rate1", "rate3", "online", "batch"],
+    )
+    def test_no_unexpected_exception(self, make):
+        for series in self.SERIES:
+            for interval in self.INTERVALS:
+                try:
+                    out = make().detect(list(series), interval)
+                except ValueError:
+                    continue  # documented parameter/empty errors
+                assert isinstance(out, list)
+
+
 class TestHoltWintersBoundaries:
     """reference: seasonal/HoltWintersTest.scala (224 LoC)."""
 
