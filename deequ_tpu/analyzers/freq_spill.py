@@ -236,10 +236,11 @@ class SpilledFrequencies(State):
         groups one column)."""
         from deequ_tpu.analyzers.frequency import top_n_order
 
-        assert len(self.columns) == 1, (
-            "top_n's deterministic tie-break is defined for single-column "
-            f"states, got {self.columns}"
-        )
+        if len(self.columns) != 1:
+            raise ValueError(
+                "top_n's deterministic tie-break is defined for "
+                f"single-column states, got {self.columns}"
+            )
 
         best_keys: List[List[np.ndarray]] = []
         best_counts: List[np.ndarray] = []

@@ -11,7 +11,7 @@ reference: repository/fs/FileSystemMetricsRepository.scala:167-195).
 from __future__ import annotations
 
 import os
-import tempfile
+import uuid
 
 
 def write_text_output(path: str, text: str, overwrite: bool = False) -> None:
@@ -20,17 +20,17 @@ def write_text_output(path: str, text: str, overwrite: bool = False) -> None:
             f"File {path} already exists and overwrite disabled"
         )
     directory = os.path.dirname(os.path.abspath(path)) or "."
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    # O_CREAT with mode 0o666 lets the KERNEL apply the caller's current
+    # umask — no os.umask() global mutation (which would race other
+    # threads) and no stale snapshot (the process may tighten its umask
+    # after import). O_EXCL + a random suffix keeps the tmp private to us.
+    tmp = os.path.join(directory, f".{uuid.uuid4().hex}.tmp")
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o666)
     try:
         with os.fdopen(fd, "w", encoding="utf-8") as f:
             f.write(text)
             if not text.endswith("\n"):
                 f.write("\n")
-        # mkstemp creates 0600; give the artifact the normal
-        # umask-respecting mode a plain open() would have produced
-        umask = os.umask(0)
-        os.umask(umask)
-        os.chmod(tmp, 0o666 & ~umask)
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):

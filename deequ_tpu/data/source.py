@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Callable, Iterator, List, Optional, Tuple
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -240,9 +240,15 @@ class MappedSource(DataSource):
         base,
         fn: Callable[[Table], Table],
         schema_overrides: Optional[List[Tuple[str, ColumnType]]] = None,
+        fn_columns: Optional[Sequence[str]] = None,
     ):
         self.base = base
         self.fn = fn
+        # fn's read set. Column pruning can only be forwarded past fn when
+        # the caller declares which columns fn consumes — an undeclared fn
+        # may derive one column from another, and a pruned batch would
+        # silently starve it (or raise mid-scan).
+        self.fn_columns = None if fn_columns is None else tuple(fn_columns)
         self._overrides = list(schema_overrides or [])
         overrides = dict(self._overrides)
         self._schema_cache = [
@@ -254,11 +260,19 @@ class MappedSource(DataSource):
         base_wc = getattr(self.base, "with_columns", None)
         if base_wc is None:
             return self
-        kept = set(names)
+        if self.fn_columns is None:
+            # fn's read set is unknown: pruning the base could starve it
+            return self
+        # the pruned source's schema is names ∪ fn_columns (fn's inputs
+        # stay decoded and visible — a superset of the request, like an
+        # unprunable source would be); overrides are kept for EVERY
+        # surviving column so the schema matches what fn actually emits
+        base_needs = sorted(set(names) | set(self.fn_columns))
         return MappedSource(
-            base_wc(names),
+            base_wc(base_needs),
             self.fn,
-            [(n, t) for n, t in self._overrides if n in kept],
+            [(n, t) for n, t in self._overrides if n in set(base_needs)],
+            fn_columns=self.fn_columns,
         )
 
     def _schema(self) -> List[Tuple[str, ColumnType]]:

@@ -141,7 +141,7 @@ def placement_mode() -> str:
 
     The scheduler analogue of Spark's map-side combine decision, decided
     by a synchronized bandwidth probe whose measurement is cached on disk
-    per (platform, device kind) with a TTL (PLACEMENT_CACHE_TTL_S) — on
+    per (host, platform, device kind) with a TTL (PLACEMENT_CACHE_TTL_S) — on
     slow tunnels the probe costs seconds of startup per process, so only
     the first process in a week pays it. Override with
     DEEQU_TPU_PLACEMENT=device|host-discrete|host|auto ('host' =
@@ -182,11 +182,16 @@ PLACEMENT_CACHE_TTL_S = 7 * 24 * 3600
 
 
 def _platform_key() -> Optional[str]:
-    """Identity of the attached backend — the cache key. Bandwidth is a
-    property of the platform/device pairing, not of the process."""
+    """Identity of the attached LINK — the cache key. Bandwidth is a
+    property of how THIS HOST reaches the device, not of the device kind
+    alone: the same device kind reached locally vs over a tunnel has
+    wildly different bandwidth, so the host name is part of the key."""
+    import socket
+
     try:
         device = jax.devices()[0]
-        return f"{device.platform}:{getattr(device, 'device_kind', '?')}"
+        host = socket.gethostname() or "?"
+        return f"{host}:{device.platform}:{getattr(device, 'device_kind', '?')}"
     except Exception:  # noqa: BLE001
         return None
 
@@ -205,8 +210,8 @@ def _placement_cache_path() -> Optional[str]:
 def _load_bandwidth_from_disk() -> Optional[float]:
     """The probe costs seconds of real time on slow tunnels (two device
     compiles + synchronized fetches), so the MEASURED BANDWIDTH is
-    cached per (platform, device kind) with a TTL. Delete the file (or
-    set DEEQU_TPU_PLACEMENT) to force a re-probe."""
+    cached per (host, platform, device kind) with a TTL. Delete the file
+    (or set DEEQU_TPU_PLACEMENT) to force a re-probe."""
     import json
     import os
 
@@ -253,6 +258,15 @@ def _save_bandwidth_to_disk(bandwidth: float) -> None:
     except (OSError, ValueError):
         data = {}
     data[key] = {"bandwidth": float(bandwidth), "ts": time.time()}
+    # drop expired/garbage entries on save (old key formats and renamed
+    # hosts would otherwise sit in placement.json forever)
+    now = time.time()
+    data = {
+        k: v
+        for k, v in data.items()
+        if isinstance(v, dict)
+        and now - float(v.get("ts", 0)) <= PLACEMENT_CACHE_TTL_S
+    }
     try:
         write_text_output(path, json.dumps(data), overwrite=True)
     except OSError:

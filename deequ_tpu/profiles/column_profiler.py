@@ -279,6 +279,10 @@ def _cast_numeric_string_columns(
             data,
             cast_batch,
             schema_overrides=[(name, ColumnType.DOUBLE) for name in to_cast],
+            # cast_batch is an IN-PLACE transform (reads only the columns
+            # it rewrites, and skips pruned-away ones), so it needs no
+            # extra base columns beyond whatever the consumer requests
+            fn_columns=(),
         )
     return cast_batch(data)
 

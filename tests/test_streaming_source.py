@@ -178,3 +178,65 @@ class TestStreamingParity:
         assert set(source.column_names) == {"x", "cat"}
         ctx = AnalysisRunner.on_data(source).add_analyzers([Completeness("cat")]).run()
         assert ctx.metric_map[Completeness("cat")].value.is_success
+
+    def test_mapped_source_undeclared_fn_is_not_pruned(self, parquet_path):
+        """A MappedSource whose fn derives one column from another must
+        not have its base pruned to the analyzer-consumed columns: the
+        derivation input would go missing and silently skew the metric
+        (advisor finding, round 3). Undeclared read set => no pruning;
+        declared => base keeps names ∪ fn_columns."""
+        from deequ_tpu.data.source import MappedSource
+        from deequ_tpu.data.table import Column, ColumnType
+
+        base = Table.scan_parquet(parquet_path)
+
+        def scale_x_by_qty(batch):
+            x = batch.column("x")
+            qty = batch.column("qty")  # NOT analyzed below: prune bait
+            return batch.with_column(
+                Column(
+                    "x",
+                    ColumnType.DOUBLE,
+                    np.asarray(x.values, dtype=np.float64)
+                    * np.asarray(qty.values, dtype=np.float64),
+                    x.valid & qty.valid,
+                )
+            )
+
+        expected = (
+            AnalysisRunner.on_data(
+                MappedSource(Table.scan_parquet(parquet_path), scale_x_by_qty)
+            )
+            .add_analyzers([Mean("x")])
+            .run()
+            .metric_map[Mean("x")]
+            .value.get()
+        )
+
+        # undeclared: with_columns must be a no-op (fn still sees qty)
+        undeclared = MappedSource(base, scale_x_by_qty)
+        pruned = undeclared.with_columns(["x"])
+        got = (
+            AnalysisRunner.on_data(pruned)
+            .add_analyzers([Mean("x")])
+            .run()
+            .metric_map[Mean("x")]
+            .value.get()
+        )
+        assert got == pytest.approx(expected, rel=1e-12)
+
+        # declared: base is pruned to names ∪ fn_columns, fn still works
+        declared = MappedSource(
+            Table.scan_parquet(parquet_path),
+            scale_x_by_qty,
+            fn_columns=["x", "qty"],
+        )
+        got2 = (
+            AnalysisRunner.on_data(declared.with_columns(["x"]))
+            .add_analyzers([Mean("x")])
+            .run()
+            .metric_map[Mean("x")]
+            .value.get()
+        )
+        assert got2 == pytest.approx(expected, rel=1e-12)
+        assert "cat" not in declared.with_columns(["x"]).base.column_names
