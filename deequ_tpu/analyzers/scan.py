@@ -213,6 +213,21 @@ class Completeness(_RatioAnalyzer):
         # isNotNull(...) is never NULL: empty only when nothing was scanned
         return inputs[where_key(None)]
 
+    def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        if xp is np:
+            # Completeness's counts are exactly the (column, where)
+            # family's fused-moment counts: matches = valid∧where,
+            # count = where-true, guard = rows scanned — free when a
+            # quantile sketch already ran the combined family kernel
+            mom = inputs.get(f"__moments:{self.column}:{where_key(self.where)}")
+            if mom is not None and "n_rows" in mom:
+                return {
+                    "matches": mom["count"],
+                    "count": mom["n_where"],
+                    "guard": mom["n_rows"],
+                }
+        return super().device_reduce(inputs, xp)
+
     def __repr__(self) -> str:
         return f"Completeness({self.column},{render_where(self.where)})"
 
@@ -448,6 +463,8 @@ class _NumericScanAnalyzer(ScanShareableAnalyzer):
                     "min": float(out[2]),
                     "max": float(out[3]),
                     "m2": float(out[4]),
+                    "n_where": float(out[5]),
+                    "n_rows": float(len(x)),
                 }
             else:
                 mask = (

@@ -220,11 +220,29 @@ class ParquetSource(DataSource):
         with pq.ParquetFile(
             self.path, read_dictionary=str_cols or None
         ) as pf:
+            import pyarrow as pa
+
+            # coalesce consecutive row groups up to the batch size: files
+            # written with small groups (pyarrow defaults to 1M rows)
+            # would otherwise fix the batch at group size, multiplying
+            # the per-batch costs of the fold (~25ms of host machinery
+            # per batch, measured) by 4x. Memory stays bounded by `size`.
+            pending: list = []
+            pending_rows = 0
             for g in range(pf.metadata.num_row_groups):
                 group = pf.read_row_group(g, columns=self.columns)
-                for start in range(0, group.num_rows, size):
-                    yield Table.from_arrow(group.slice(start, size))
-                del group
+                pending.append(group)
+                pending_rows += group.num_rows
+                if pending_rows < size and g + 1 < pf.metadata.num_row_groups:
+                    continue
+                merged = (
+                    pending[0] if len(pending) == 1 else pa.concat_tables(pending)
+                )
+                pending = []
+                pending_rows = 0
+                for start in range(0, merged.num_rows, size):
+                    yield Table.from_arrow(merged.slice(start, size))
+                del merged, group
 
     def __repr__(self) -> str:
         return f"ParquetSource({self.path!r}, rows={self._num_rows})"

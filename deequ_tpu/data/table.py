@@ -437,18 +437,32 @@ class Table:
                 # others decode to their value type so the column's ctype
                 # matches what _arrow_ctype reports for the schema
                 arr = arr.dictionary_decode()
-            valid = np.asarray(arr.is_valid())
+            # null-free columns skip the fill_null/where copies and get
+            # zero-copy numpy views of the arrow buffers where possible
+            # (views are read-only; Column treats values as immutable)
+            no_nulls = arr.null_count == 0
+            valid = (
+                np.ones(len(arr), dtype=bool)
+                if no_nulls
+                else np.asarray(arr.is_valid())
+            )
             t = arr.type
             if pa.types.is_boolean(t):
-                vals = np.asarray(arr.fill_null(False))
+                vals = np.asarray(arr if no_nulls else arr.fill_null(False))
                 cols.append(Column(name, ColumnType.BOOLEAN, vals, valid))
             elif pa.types.is_integer(t):
-                vals = np.asarray(arr.fill_null(0)).astype(np.int64)
+                vals = np.asarray(arr if no_nulls else arr.fill_null(0))
+                if vals.dtype != np.int64:
+                    vals = vals.astype(np.int64)
                 cols.append(Column(name, ColumnType.LONG, vals, valid))
             elif pa.types.is_floating(t):
-                vals = np.asarray(arr.fill_null(0.0)).astype(np.float64)
-                valid = valid & ~np.isnan(vals)
-                vals = np.where(valid, vals, 0.0)
+                vals = np.asarray(arr if no_nulls else arr.fill_null(0.0))
+                if vals.dtype != np.float64:
+                    vals = vals.astype(np.float64)
+                nan = np.isnan(vals)
+                if nan.any():
+                    valid = valid & ~nan
+                    vals = np.where(valid, vals, 0.0)
                 cols.append(Column(name, ColumnType.DOUBLE, vals, valid))
             elif pa.types.is_decimal(t):
                 vals = np.array(

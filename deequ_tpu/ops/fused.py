@@ -15,6 +15,7 @@ role the reference's `State.sum` plays after Catalyst partial aggregation.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -266,6 +267,50 @@ def prune_table_columns(table, specs: Dict[str, Any]):
     return with_columns(sorted(needed))
 
 
+class HostInputs(dict):
+    """Per-batch input map for host-folded members. Host-only keys build
+    LAZILY on first access: a member that answers from a pre-pass memo
+    (e.g. ApproxCountDistinct reading fused-family HLL registers) never
+    pays for the inputs it skipped. Build failures are remembered and
+    re-raised on every access, so they fail exactly the members that
+    consume the key — the same isolation contract as the eager path."""
+
+    def __init__(self, specs: Dict[str, Any], batch):
+        super().__init__()
+        self._specs = specs
+        self.batch = batch
+        self.build_errors: Dict[str, BaseException] = {}
+
+    def materialize(self, key: str) -> None:
+        try:
+            self[key]
+        except Exception:  # noqa: BLE001 - recorded in build_errors
+            pass
+
+    def __missing__(self, key):
+        err = self.build_errors.get(key)
+        if err is not None:
+            raise err
+        spec = self._specs.get(key)
+        if spec is None:
+            raise KeyError(key)
+        try:
+            value = np.asarray(spec.build(self.batch))
+        except Exception as e:  # noqa: BLE001
+            self.build_errors[key] = e
+            raise
+        self[key] = value
+        return value
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            if key in self.build_errors:
+                raise
+            return default
+
+
 def fold_host_batch(
     built: Dict[str, np.ndarray],
     build_errors: Dict[str, BaseException],
@@ -275,12 +320,15 @@ def fold_host_batch(
     host_aggs: Dict[int, Any],
     host_assisted_states: Dict[int, Any],
     host_errors: Dict[int, BaseException],
+    batch=None,
+    streaming: bool = False,
 ) -> None:
     """One batch's host-placed fold, shared by FusedScanPass and
     DistributedScanPass: merge members run their xp-generic reduce with
     numpy; assisted members (sketches) run the SAME per-batch computation
     the device would (sort+decimate) and fold via host_consume. A failed
     input fails only the members that need it."""
+    _precompute_family_kernels(built, host_assisted, batch if streaming else None)
     for i, member in host_members:
         if i in host_errors:
             continue
@@ -306,6 +354,143 @@ def fold_host_batch(
             )
         except Exception as e:  # noqa: BLE001
             host_errors[i] = e
+
+
+_FAMILY_POOL = None
+
+
+def _family_pool():
+    """Process-wide worker pool for family kernels (created once: the C
+    kernels' thread-local arenas stay warm and bounded per thread)."""
+    global _FAMILY_POOL
+    if _FAMILY_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _FAMILY_POOL = ThreadPoolExecutor(
+            max_workers=min(8, os.cpu_count() or 1),
+            thread_name_prefix="deequ-family",
+        )
+    return _FAMILY_POOL
+
+
+def _family_hll_mode(batch, column: str):
+    """(hll_mode, hashvals) for folding the column's HLL++ register
+    update into the family kernel — matching canonical_int64's identity
+    rules exactly (ops/sketches/hll.py): floats hash their f64 bit
+    pattern (mode 1); ints and bools hash the canonical int64 VALUE
+    (mode 2, via the original backing array — no float roundtrip).
+    (0, None) when the identity can't be reproduced in-kernel.
+
+    Only STREAMING scans fold HLL this way (the caller passes
+    batch=None otherwise): in-memory tables amortize the hash+pack
+    across runs through the per-column encode cache, which is cheaper
+    than re-hashing inside every family-kernel call — a stream's batches
+    are fresh columns with nothing to amortize."""
+    from deequ_tpu.data.table import ColumnType
+
+    if batch is None:
+        return 0, None
+    try:
+        col = batch.column(column)
+    except Exception:  # noqa: BLE001
+        return 0, None
+    if col.ctype == ColumnType.DOUBLE and col.values.dtype == np.float64:
+        return 1, None
+    if col.ctype == ColumnType.LONG and col.values.dtype == np.int64:
+        return 2, col.values
+    if col.ctype == ColumnType.BOOLEAN and col.values.dtype == np.bool_:
+        return 2, col.values.astype(np.int64)
+    return 0, None
+
+
+def _precompute_family_kernels(
+    built: Dict[str, np.ndarray], host_assisted, batch=None
+) -> None:
+    """Host-fold scan sharing ACROSS analyzer kinds: when a quantile
+    sketch rides the pass, one combined C traversal produces the
+    (column, where) family's fused moments (consumed by
+    Mean/Min/Max/Sum/StdDev via their `_moments` memo), the sketch's
+    decimated sample, AND the column's HLL++ registers (consumed by
+    ApproxCountDistinct, whose hash inputs then never get built at all
+    under the lazy HostInputs map) — two passes over the column instead
+    of the seven that separate kernels would pay. Results land in the
+    per-batch memo keys the members already read; any failure simply
+    leaves the memos unset and each member computes on its own."""
+    from deequ_tpu.analyzers.base import where_key
+    from deequ_tpu.ops import native
+
+    jobs = []
+    for _, member in host_assisted:
+        sample_size = getattr(member, "_sample_size", None)
+        column = getattr(member, "column", None)
+        if sample_size is None or column is None:
+            continue
+        where = getattr(member, "where", None)
+        wkey = where_key(where)
+        cap = int(sample_size())
+        qkey = f"__qsample:{column}:{wkey}:{cap}"
+        mkey = f"__moments:{column}:{wkey}"
+        if qkey in built or any(j[0] == qkey for j in jobs):
+            continue
+        try:
+            x = np.asarray(built[f"num:{column}"])
+            valid = np.asarray(built[f"valid:{column}"])
+            warr = None if where is None else np.asarray(built[wkey])
+            if valid.dtype != np.bool_ or (
+                warr is not None and warr.dtype != np.bool_
+            ):
+                continue
+        except Exception:  # noqa: BLE001 - memo stays unset, members recompute
+            continue
+        hll_mode, hashvals = _family_hll_mode(batch, column)
+        rkey = f"__hllregs:{column}:{wkey}"
+        jobs.append((qkey, mkey, rkey, x, valid, warr, cap, hll_mode, hashvals))
+
+    if not jobs:
+        return
+
+    def run_one(job):
+        qkey, mkey, rkey, x, valid, warr, cap, hll_mode, hashvals = job
+        try:
+            return (
+                native.masked_moments_select(
+                    x, valid, warr, cap, hll_mode=hll_mode, hashvals=hashvals
+                ),
+                len(x),
+            )
+        except Exception:  # noqa: BLE001
+            return None, len(x)
+
+    if len(jobs) > 1 and (os.cpu_count() or 1) > 1:
+        # the C kernel releases the GIL: independent column families run
+        # concurrently on multicore hosts (a no-op gain on 1-core boxes).
+        # ONE long-lived pool: the kernel keeps grow-only thread-local
+        # arenas, so short-lived per-batch threads would leak them.
+        outcomes = list(_family_pool().map(run_one, jobs))
+    else:
+        outcomes = [run_one(j) for j in jobs]
+
+    for (qkey, mkey, rkey, *_rest), (res, n_rows) in zip(jobs, outcomes):
+        if res is None:
+            continue
+        mom, sample, n_valid, level, regs = res
+        built[qkey] = {
+            "sample": sample,
+            "n": np.asarray([n_valid], dtype=np.float64),
+            "level": np.asarray([level], dtype=np.int32),
+        }
+        if regs is not None:
+            built[rkey] = regs
+        if mkey not in built:
+            built[mkey] = {
+                "count": float(mom[0]),
+                "sum": float(mom[1]),
+                "min": float(mom[2]),
+                "max": float(mom[3]),
+                "m2": float(mom[4]),
+                "n_where": float(mom[5]),
+                "n_rows": float(n_rows),
+            }
 
 
 def materialize_host_results(
@@ -435,7 +620,9 @@ class FusedScanPass:
                 results[i] = AnalyzerRunResult(analyzer, error=e)
                 continue
             if getattr(analyzer, "device_assisted", False):
-                if host_all:
+                if host_all or getattr(analyzer, "host_only", False):
+                    # host_only: inputs (strings, dict codes) never ship
+                    # to the device regardless of placement
                     host_assisted_idx.append(i)
                     host_keys[i] = [s.key for s in analyzer_specs]
                 else:
@@ -532,6 +719,7 @@ class FusedScanPass:
 
         fold = PipelinedAggFold(analyzers, assisted)
         device_spec_keys = sorted(device_keys)
+        streaming = bool(getattr(table, "is_streaming", False))
 
         # host fold state: per host member, (f64 aggregate, error)
         host_aggs: Dict[int, Any] = {}
@@ -561,13 +749,13 @@ class FusedScanPass:
             host_live = any(i not in host_errors for i, _m in all_host)
             if not device_live and not host_live:
                 break  # everything already failed; stop scanning
-            built: Dict[str, np.ndarray] = {}
-            build_errors: Dict[str, BaseException] = {}
-            for key in sorted(live_keys):
-                try:
-                    built[key] = np.asarray(specs[key].build(batch))
-                except Exception as e:  # noqa: BLE001
-                    build_errors[key] = e
+            # device keys build eagerly (the shared program needs them
+            # packed); host-only keys build lazily on first member access
+            built = HostInputs(specs, batch)
+            build_errors = built.build_errors
+            if device_live:
+                for key in device_spec_keys:
+                    built.materialize(key)
             if use_device and device_error is None:
                 try:
                     for key in device_spec_keys:
@@ -589,6 +777,7 @@ class FusedScanPass:
             fold_host_batch(
                 built, build_errors, host_members, host_assisted,
                 host_member_keys, host_aggs, host_assisted_states, host_errors,
+                batch=batch, streaming=streaming,
             )
 
         aggs, assisted_states = [], []

@@ -141,6 +141,30 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_int64),
             ]
             fn.restype = None
+        lib.masked_select_decimate.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.masked_select_decimate.restype = ctypes.c_int
+        lib.masked_moments_select.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.masked_moments_select.restype = ctypes.c_int
         _LIB = lib
     except OSError:
         _LIB = None
@@ -243,6 +267,101 @@ def bincount(
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     return out
+
+
+def masked_select_decimate(
+    x: np.ndarray,
+    valid: Optional[np.ndarray],
+    where: Optional[np.ndarray],
+    cap: int,
+):
+    """The quantile sketch's per-batch heavy step: exactly
+    ``sorted(x[valid & where])[stride//2::stride][:cap]`` (stride =
+    2^ceil(log2(n_valid/cap))) via histogram-assisted selection — no full
+    sort. Returns (samples_f64, n_valid, level), or None when native is
+    unavailable (caller falls back to the numpy sort path)."""
+    lib = _load()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float64)
+    valid = _u8_ptr(valid)
+    where = _u8_ptr(where)
+    samples = np.empty(max(int(cap), 1), dtype=np.float64)
+    meta = np.zeros(3, dtype=np.int64)
+    rc = lib.masked_select_decimate(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if valid is not None
+        else None,
+        where.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if where is not None
+        else None,
+        len(x),
+        int(cap),
+        samples.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        meta.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if rc != 0:
+        return None
+    return samples[: int(meta[2])], int(meta[0]), int(meta[1])
+
+
+def masked_moments_select(
+    x: np.ndarray,
+    valid: Optional[np.ndarray],
+    where: Optional[np.ndarray],
+    cap: int,
+    hll_mode: int = 0,
+    hashvals: Optional[np.ndarray] = None,
+):
+    """Combined (column, where)-family kernel: the fused moments
+    [count, sum, min, max, m2, n_where] AND the quantile sketch's
+    decimated sample, in the same data traversals (two passes instead of
+    the five that masked_moments + masked_select_decimate would pay).
+    hll_mode folds the HLL++ register update into the same pass:
+    1 = hash x's f64 bit pattern (float columns), 2 = hash the parallel
+    canonical-int64 array `hashvals` (int/bool columns). Returns
+    (moments6, samples_f64, n_valid, level, registers_or_None) or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float64)
+    valid = _u8_ptr(valid)
+    where = _u8_ptr(where)
+    samples = np.empty(max(int(cap), 1), dtype=np.float64)
+    meta = np.zeros(3, dtype=np.int64)
+    mom = np.zeros(6, dtype=np.float64)
+    regs = None
+    regs_ptr = None
+    hash_ptr = None
+    if hll_mode == 2 and hashvals is not None:
+        hashvals = np.ascontiguousarray(hashvals, dtype=np.int64)
+        hash_ptr = hashvals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    elif hll_mode == 2:
+        hll_mode = 0
+    if hll_mode:
+        regs = np.zeros(512, dtype=np.int32)
+        regs_ptr = regs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    rc = lib.masked_moments_select(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if valid is not None
+        else None,
+        where.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if where is not None
+        else None,
+        len(x),
+        int(cap),
+        samples.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        meta.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        mom.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        hash_ptr,
+        int(hll_mode),
+        regs_ptr,
+    )
+    if rc != 0:
+        return None
+    return mom, samples[: int(meta[2])], int(meta[0]), int(meta[1]), regs
 
 
 def hll_update_registers(

@@ -147,3 +147,538 @@ void masked_moments(const double *x, const uint8_t *valid,
     out[4] = (double)m2;
     out[5] = where ? (double)n_where : (double)n;
 }
+
+/* ---------------------------------------------------------------------
+ * Masked select-decimate: the per-batch heavy step of the quantile
+ * sketch (analyzers/sketch.py device_batch). Computes EXACTLY
+ *
+ *     xm = sorted(x[valid & where]); xm[stride/2 :: stride][:cap]
+ *     with stride = 2^level, level = ceil(log2(n_valid / cap))
+ *
+ * i.e. `cap` evenly spaced order statistics — WITHOUT sorting the whole
+ * batch. The role this plays is the reference's per-partition quantile
+ * digest update (reference: catalyst/StatefulApproxQuantile.scala:28).
+ *
+ * Method: map doubles to order-preserving uint64 keys and run an MSD
+ * radix SELECT: histogram the keys on the most significant varying bits
+ * (16 at the top level, 8 below), locate each wanted rank's bucket via
+ * prefix sums, then gather and recurse ONLY into buckets that own a
+ * wanted rank. Buckets whose min==max key are constant and resolve
+ * without gathering (low-cardinality columns stay O(n)); segments
+ * below 48 keys use insertion sort. IEEE exponent clustering (the case
+ * that defeats single-level top-bit bucketing) just recurses one level
+ * deeper into the mantissa bits.
+ *
+ * All large buffers come from a THREAD-LOCAL grow-only arena: repeated
+ * calls (one per column per batch) reuse warm pages instead of paying
+ * ~8k page faults per fresh 32MB malloc (measured: that was half the
+ * kernel's wall time). Bounded by the largest batch ever processed per
+ * thread.
+ *
+ * Determinism: key order equals IEEE total order on doubles (with -0.0
+ * before +0.0 and NaN last; equal doubles are interchangeable in the
+ * decimated sample, so the result matches the numpy sort path).
+ *
+ * Returns 0 on success (meta = [n_valid, level, kept], samples[kept]
+ * filled), 1 on allocation failure (caller falls back to numpy). */
+
+#include <stdlib.h>
+#include <string.h>
+
+#define SD_MAX_DEPTH 16
+#define SD_TOP_BUCKETS 16384
+
+/* arena slots: 0 = keys, 1 = top-level tables, 2+d = scratch at depth d */
+/* slots: 0 = keys/gather scratch, 1 = top tables, 2+d = recursion
+ * scratch at depth d, 18..23 = entry-point planning tables */
+#define SD_ARENA_SLOTS (2 + SD_MAX_DEPTH + 6)
+static __thread struct { void *p; size_t cap; } sd_arena[SD_ARENA_SLOTS];
+
+static void *sd_get(int slot, size_t bytes) {
+    if (sd_arena[slot].cap < bytes) {
+        free(sd_arena[slot].p);
+        size_t ncap = bytes + bytes / 2 + 64;
+        sd_arena[slot].p = malloc(ncap);
+        sd_arena[slot].cap = sd_arena[slot].p ? ncap : 0;
+    }
+    return sd_arena[slot].p;
+}
+
+static inline uint64_t f64_key(double v) {
+    uint64_t u;
+    memcpy(&u, &v, 8);
+    return (u >> 63) ? ~u : (u | 0x8000000000000000ULL);
+}
+
+static inline double key_f64(uint64_t k) {
+    uint64_t u = (k >> 63) ? (k & 0x7FFFFFFFFFFFFFFFULL) : ~k;
+    double v;
+    memcpy(&v, &u, 8);
+    return v;
+}
+
+static void ins_sort_u64(uint64_t *a, int64_t n) {
+    for (int64_t i = 1; i < n; i++) {
+        uint64_t v = a[i];
+        int64_t j = i - 1;
+        while (j >= 0 && a[j] > v) {
+            a[j + 1] = a[j];
+            j--;
+        }
+        a[j + 1] = v;
+    }
+}
+
+/* Resolve wanted ranks r_j = roff + j*step (j in [j0, j1), all with
+ * 0 <= r_j < m) against the UNSORTED keys[0..m) whose min/max are
+ * kmin/kmax. Writes samples[j]. May permute keys. */
+static int resolve_segment(uint64_t *keys, int64_t m, uint64_t kmin,
+                           uint64_t kmax, int64_t roff, int64_t step,
+                           int64_t j0, int64_t j1, double *samples,
+                           int depth) {
+    if (j0 >= j1) return 0;
+    if (kmin == kmax) {
+        double v = key_f64(kmin);
+        for (int64_t j = j0; j < j1; j++) samples[j] = v;
+        return 0;
+    }
+    if (m <= 48 || depth + 1 >= SD_MAX_DEPTH) {
+        ins_sort_u64(keys, m);
+        for (int64_t j = j0; j < j1; j++)
+            samples[j] = key_f64(keys[roff + j * step]);
+        return 0;
+    }
+
+    int width = depth == 0 ? 16 : 8;
+    int hb = 63 - __builtin_clzll(kmin ^ kmax);
+    int shift = hb + 1 - width;
+    if (shift < 0) shift = 0;
+    uint64_t base = kmin >> shift;
+    int64_t nbuckets = (int64_t)((kmax >> shift) - base) + 1;
+
+    /* tables: stack at depth >= 1 (<= 256 buckets), arena at the top */
+    uint32_t hist_stack[256];
+    uint64_t bmin_stack[256], bmax_stack[256];
+    int64_t cstart_stack[256], cfill_stack[256];
+    uint32_t *hist;
+    uint64_t *bmin, *bmax;
+    int64_t *cstart, *cfill;
+    if (nbuckets <= 256) {
+        hist = hist_stack;
+        bmin = bmin_stack;
+        bmax = bmax_stack;
+        cstart = cstart_stack;
+        cfill = cfill_stack;
+    } else {
+        char *tables = (char *)sd_get(
+            1, (size_t)nbuckets * (4 + 8 + 8 + 8 + 8));
+        if (!tables) return 1;
+        hist = (uint32_t *)tables;
+        bmin = (uint64_t *)(tables + (size_t)nbuckets * 4);
+        bmax = bmin + nbuckets;
+        cstart = (int64_t *)(bmax + nbuckets);
+        cfill = cstart + nbuckets;
+    }
+    memset(hist, 0, (size_t)nbuckets * 4);
+    memset(bmin, 0xFF, (size_t)nbuckets * 8);
+    memset(bmax, 0x00, (size_t)nbuckets * 8);
+
+    for (int64_t i = 0; i < m; i++) {
+        uint64_t k = keys[i];
+        int64_t b = (int64_t)((k >> shift) - base);
+        hist[b]++;
+        if (k < bmin[b]) bmin[b] = k;
+        if (k > bmax[b]) bmax[b] = k;
+    }
+
+    /* walk buckets in key order; resolve constant ones, mark the rest */
+    int64_t collect_total = 0;
+    {
+        int64_t rank0 = 0;
+        for (int64_t b = 0; b < nbuckets; b++) {
+            int64_t c = (int64_t)hist[b];
+            cstart[b] = -1;
+            if (c > 0) {
+                int64_t jlo =
+                    (roff + j0 * step < rank0)
+                        ? j0 + (rank0 - roff - j0 * step + step - 1) / step
+                        : j0;
+                if (jlo < j1 && roff + jlo * step < rank0 + c) {
+                    if (bmin[b] == bmax[b]) {
+                        double v = key_f64(bmin[b]);
+                        for (int64_t j = jlo;
+                             j < j1 && roff + j * step < rank0 + c; j++)
+                            samples[j] = v;
+                    } else {
+                        cstart[b] = collect_total;
+                        collect_total += c;
+                    }
+                }
+                rank0 += c;
+            }
+        }
+    }
+
+    int rc = 0;
+    if (collect_total > 0) {
+        uint64_t *scratch =
+            (uint64_t *)sd_get(2 + depth, (size_t)collect_total * 8);
+        if (!scratch) return 1;
+        memcpy(cfill, cstart, (size_t)nbuckets * 8);
+        for (int64_t i = 0; i < m; i++) {
+            uint64_t k = keys[i];
+            int64_t b = (int64_t)((k >> shift) - base);
+            if (cstart[b] >= 0) scratch[cfill[b]++] = k;
+        }
+        int64_t rank0 = 0;
+        for (int64_t b = 0; b < nbuckets && rc == 0; b++) {
+            int64_t c = (int64_t)hist[b];
+            if (c > 0) {
+                if (cstart[b] >= 0) {
+                    int64_t jlo =
+                        (roff + j0 * step < rank0)
+                            ? j0 + (rank0 - roff - j0 * step + step - 1) / step
+                            : j0;
+                    int64_t jhi = jlo;
+                    while (jhi < j1 && roff + jhi * step < rank0 + c) jhi++;
+                    /* shift == 0 with bmin != bmax is impossible (the
+                     * bucket id is then the full key), so recursion
+                     * always has bits left to split on */
+                    rc = resolve_segment(scratch + cstart[b], c, bmin[b],
+                                         bmax[b], roff - rank0, step, jlo,
+                                         jhi, samples, depth + 1);
+                }
+                rank0 += c;
+            }
+        }
+    }
+    return rc;
+}
+
+/* Entry point. Three direct masked passes over x (no key-buffer
+ * materialization for the common case):
+ *   P1: fixed 16-bit-prefix histogram + per-bucket min/max key
+ *   P2: 8-bit count-only sub-histograms for buckets owning wanted ranks
+ *   P3: gather only the sub-buckets owning wanted ranks
+ * then resolve each gathered sub-bucket with resolve_segment (insertion
+ * sort when tiny, recursion when an adversarial distribution concentrates
+ * a sub-bucket). Constant buckets short-circuit at both levels. The rare
+ * all-keys-share-top-16-bits case compacts keys and uses the adaptive
+ * recursive path directly. */
+
+#define SD_TOP_SHIFT 50
+#define SD_SUB_BITS 8
+#define SD_SUB_W (1 << SD_SUB_BITS)
+
+static inline int sd_masked_out(const uint8_t *valid, const uint8_t *where,
+                                int64_t i) {
+    return (valid && !valid[i]) || (where && !where[i]);
+}
+
+/* core: select-decimate, optionally accumulating the masked-moments
+ * family outputs [count, sum, min, max, m2, n_where] into mom (NULL =
+ * skip) — the moments ride P1/P2's traversals instead of paying their
+ * own two passes (ops/native masked_moments). hll_mode additionally
+ * folds the HLL++ register update into P1 (the reference's
+ * StatefulHyperloglogPlus per-row loop): 0 = off, 1 = hash the f64 bit
+ * pattern of x[i] (float columns' canonical identity), 2 = hash
+ * hashvals[i] (caller-supplied canonical int64 per row — int/bool
+ * columns, whose identity is the integer value, not the float bits).
+ * regs must hold 1 << P int32 slots (caller-zeroed). */
+static int sd_core(const double *x, const uint8_t *valid,
+                   const uint8_t *where, int64_t n, int64_t cap,
+                   double *samples, int64_t *meta, double *mom,
+                   const int64_t *hashvals, int hll_mode, int32_t *regs) {
+    if (cap <= 0) return 1;
+
+    /* ---- P1: top histogram + per-bucket min/max + global min/max.
+     * One 24-byte struct per bucket (single cache line per update);
+     * 14-bit top level keeps the whole table L2-resident. ---- */
+    typedef struct {
+        uint64_t mn, mx;
+        uint32_t cnt, pad;
+    } SdTop;
+    SdTop *top = (SdTop *)sd_get(1, (size_t)SD_TOP_BUCKETS * sizeof(SdTop));
+    if (!top) return 1;
+    for (int64_t b = 0; b < SD_TOP_BUCKETS; b++) {
+        top[b].mn = ~0ULL;
+        top[b].mx = 0ULL;
+        top[b].cnt = 0;
+    }
+
+    int64_t m = 0, n_where = 0;
+    uint64_t kmin = ~0ULL, kmax = 0ULL;
+    long double sum = 0.0L;
+    for (int64_t i = 0; i < n; i++) {
+        if (where && !where[i]) continue;
+        n_where++;
+        if (valid && !valid[i]) continue;
+        uint64_t k = f64_key(x[i]);
+        SdTop *t = &top[k >> SD_TOP_SHIFT];
+        m++;
+        t->cnt++;
+        if (k < t->mn) t->mn = k;
+        if (k > t->mx) t->mx = k;
+        if (k < kmin) kmin = k;
+        if (k > kmax) kmax = k;
+        if (mom) sum += x[i];
+        if (hll_mode) {
+            uint64_t canon;
+            if (hll_mode == 1) {
+                memcpy(&canon, &x[i], 8);
+            } else {
+                canon = (uint64_t)hashvals[i];
+            }
+            uint64_t h = xxhash64_u64(canon);
+            int32_t idx = (int32_t)(h >> (64 - P));
+            uint64_t rest = (h << P) | (1ULL << (P - 1));
+            int rank = 1 + __builtin_clzll(rest);
+            if (rank > 64 - P + 1) rank = 64 - P + 1;
+            if (rank > regs[idx]) regs[idx] = rank;
+        }
+    }
+    if (mom) {
+        mom[0] = (double)m;
+        mom[1] = (double)sum;
+        mom[2] = m > 0 ? key_f64(kmin) : (double)INFINITY;
+        mom[3] = m > 0 ? key_f64(kmax) : -(double)INFINITY;
+        mom[4] = 0.0; /* m2 filled below */
+        mom[5] = where ? (double)n_where : (double)n;
+    }
+    meta[0] = m;
+    meta[1] = 0;
+    meta[2] = 0;
+    if (m == 0) return 0;
+
+    int level = 0;
+    while (((int64_t)cap << level) < m) level++;
+    int64_t stride = 1LL << level;
+    int64_t offset = stride / 2;
+    int64_t kept = (m - offset + stride - 1) / stride;
+    if (kept < 0) kept = 0;
+    meta[1] = level;
+    meta[2] = kept;
+    if (kept == 0) return 0;
+
+    if (kmin == kmax) {
+        double v = key_f64(kmin);
+        for (int64_t j = 0; j < kept; j++) samples[j] = v;
+        return 0;
+    }
+    if ((kmin >> SD_TOP_SHIFT) == (kmax >> SD_TOP_SHIFT)) {
+        /* all keys share the top 16 bits: compact and go adaptive */
+        uint64_t *keys = (uint64_t *)sd_get(0, (size_t)m * 8);
+        if (!keys) return 1;
+        int64_t w = 0;
+        for (int64_t i = 0; i < n; i++) {
+            if (sd_masked_out(valid, where, i)) continue;
+            keys[w++] = f64_key(x[i]);
+        }
+        if (mom) {
+            long double m2 = 0.0L;
+            double avg = mom[1] / (double)m;
+            for (int64_t i = 0; i < m; i++) {
+                double d = key_f64(keys[i]) - avg;
+                m2 += d * d;
+            }
+            mom[4] = (double)m2;
+        }
+        return resolve_segment(keys, m, kmin, kmax, offset, stride, 0, kept,
+                               samples, 0);
+    }
+
+    /* ---- walk top buckets: resolve constant ones, plan the rest ----- */
+    /* per planned bucket: sub-shift/base; subidx maps bucket -> plan # */
+    int32_t *subidx = (int32_t *)sd_get(18, (size_t)SD_TOP_BUCKETS * 4);
+    if (!subidx) return 1;
+    memset(subidx, 0xFF, (size_t)SD_TOP_BUCKETS * 4);
+    int32_t nplanned = 0;
+    /* plans are bounded by kept <= cap (each owns >= 1 wanted rank) */
+    typedef struct {
+        int64_t bucket, rank0, jlo, jhi;
+        int shift;
+        uint64_t base;
+    } SdPlan;
+    SdPlan *plans = (SdPlan *)sd_get(19, (size_t)kept * sizeof(SdPlan));
+    if (!plans) return 1;
+    {
+        int64_t rank0 = 0;
+        for (int64_t b = 0; b < SD_TOP_BUCKETS; b++) {
+            int64_t c = (int64_t)top[b].cnt;
+            if (c == 0) continue;
+            int64_t jlo = (offset < rank0)
+                              ? (rank0 - offset + stride - 1) / stride
+                              : 0;
+            if (jlo < kept && offset + jlo * stride < rank0 + c) {
+                if (top[b].mn == top[b].mx) {
+                    double v = key_f64(top[b].mn);
+                    for (int64_t j = jlo;
+                         j < kept && offset + j * stride < rank0 + c; j++)
+                        samples[j] = v;
+                } else {
+                    int64_t jhi = jlo;
+                    while (jhi < kept && offset + jhi * stride < rank0 + c)
+                        jhi++;
+                    int hb = 63 - __builtin_clzll(top[b].mn ^ top[b].mx);
+                    int shift = hb + 1 - SD_SUB_BITS;
+                    if (shift < 0) shift = 0;
+                    SdPlan *p = &plans[nplanned];
+                    p->bucket = b;
+                    p->rank0 = rank0;
+                    p->jlo = jlo;
+                    p->jhi = jhi;
+                    p->shift = shift;
+                    p->base = top[b].mn >> shift;
+                    subidx[b] = nplanned++;
+                }
+            }
+            rank0 += c;
+        }
+    }
+
+    long double m2acc = 0.0L;
+    double avg = mom && m > 0 ? mom[1] / (double)m : 0.0;
+    if (nplanned == 0) {
+        /* every wanted bucket was constant; m2 still needs a pass */
+        if (mom && m > 0) {
+            for (int64_t i = 0; i < n; i++) {
+                if (sd_masked_out(valid, where, i)) continue;
+                double d = x[i] - avg;
+                m2acc += d * d;
+            }
+            mom[4] = (double)m2acc;
+        }
+        return 0;
+    }
+
+    /* ---- P2: 256-wide sub-histograms (+min/max: constant detection
+     * at the sub level keeps low-cardinality columns gather-free).
+     * Count/min/max share one 24-byte struct: a sub-bucket update
+     * touches ONE cache line, not three. ------------------------------ */
+    typedef struct {
+        uint64_t mn, mx;
+        uint32_t cnt, pad;
+    } SdSub;
+    SdSub *sub =
+        (SdSub *)sd_get(20, (size_t)nplanned * SD_SUB_W * sizeof(SdSub));
+    if (!sub) return 1;
+    for (int64_t s = 0; s < (int64_t)nplanned * SD_SUB_W; s++) {
+        sub[s].mn = ~0ULL;
+        sub[s].mx = 0ULL;
+        sub[s].cnt = 0;
+    }
+    for (int64_t i = 0; i < n; i++) {
+        if (sd_masked_out(valid, where, i)) continue;
+        uint64_t k = f64_key(x[i]);
+        int32_t si = subidx[k >> SD_TOP_SHIFT];
+        if (si >= 0) {
+            SdPlan *p = &plans[si];
+            SdSub *s =
+                &sub[((int64_t)si << SD_SUB_BITS) +
+                     (int64_t)((k >> p->shift) - p->base)];
+            s->cnt++;
+            if (k < s->mn) s->mn = k;
+            if (k > s->mx) s->mx = k;
+        }
+        if (mom) {
+            double d = x[i] - avg;
+            m2acc += d * d;
+        }
+    }
+    if (mom) mom[4] = (double)m2acc;
+
+    /* ---- walk sub-buckets: mark the ones owning wanted ranks -------- */
+    /* gather offsets per (plan, sub-bucket); wanted segments <= kept */
+    int32_t *gstart = (int32_t *)sd_get(21, (size_t)nplanned * SD_SUB_W * 4);
+    if (!gstart) return 1;
+    memset(gstart, 0xFF, (size_t)nplanned * SD_SUB_W * 4);
+    typedef struct {
+        int64_t gofs, count, rank0, jlo, jhi;
+        uint64_t kmin, kmax;
+    } SdSeg;
+    SdSeg *segs = (SdSeg *)sd_get(22, (size_t)kept * sizeof(SdSeg));
+    if (!segs) return 1;
+    int32_t nsegs = 0;
+    int64_t gather_total = 0;
+    for (int32_t si = 0; si < nplanned; si++) {
+        SdPlan *p = &plans[si];
+        int64_t rank0 = p->rank0;
+        int64_t j = p->jlo;
+        for (int64_t sb = 0; sb < SD_SUB_W && j < p->jhi; sb++) {
+            int64_t slot = ((int64_t)si << SD_SUB_BITS) + sb;
+            int64_t c = (int64_t)sub[slot].cnt;
+            if (c == 0) continue;
+            if (offset + j * stride < rank0 + c) {
+                int64_t jhi = j;
+                while (jhi < p->jhi && offset + jhi * stride < rank0 + c)
+                    jhi++;
+                if (sub[slot].mn == sub[slot].mx) {
+                    double v = key_f64(sub[slot].mn);
+                    for (int64_t jj = j; jj < jhi; jj++) samples[jj] = v;
+                } else {
+                    gstart[slot] = (int32_t)nsegs;
+                    SdSeg *s = &segs[nsegs++];
+                    s->gofs = gather_total;
+                    s->count = c;
+                    s->rank0 = rank0;
+                    s->jlo = j;
+                    s->jhi = jhi;
+                    s->kmin = sub[slot].mn;
+                    s->kmax = sub[slot].mx;
+                    gather_total += c;
+                }
+                j = jhi;
+            }
+            rank0 += c;
+        }
+    }
+
+    if (nsegs == 0) return 0; /* all wanted sub-buckets were constant */
+
+    /* ---- P3: gather wanted sub-buckets ------------------------------ */
+    uint64_t *scratch = (uint64_t *)sd_get(0, (size_t)gather_total * 8);
+    int64_t *gfill = (int64_t *)sd_get(23, (size_t)nsegs * 8);
+    if (!scratch || !gfill) return 1;
+    for (int32_t s = 0; s < nsegs; s++) gfill[s] = segs[s].gofs;
+    for (int64_t i = 0; i < n; i++) {
+        if (sd_masked_out(valid, where, i)) continue;
+        uint64_t k = f64_key(x[i]);
+        int32_t si = subidx[k >> SD_TOP_SHIFT];
+        if (si >= 0) {
+            SdPlan *p = &plans[si];
+            int32_t g =
+                gstart[((int64_t)si << SD_SUB_BITS) +
+                       (int64_t)((k >> p->shift) - p->base)];
+            if (g >= 0) scratch[gfill[g]++] = k;
+        }
+    }
+
+    /* ---- resolve each gathered segment ------------------------------ */
+    for (int32_t s = 0; s < nsegs; s++) {
+        SdSeg *sg = &segs[s];
+        int rc = resolve_segment(scratch + sg->gofs, sg->count, sg->kmin,
+                                 sg->kmax, offset - sg->rank0, stride,
+                                 sg->jlo, sg->jhi, samples, 1);
+        if (rc) return rc;
+    }
+    return 0;
+}
+
+int masked_select_decimate(const double *x, const uint8_t *valid,
+                           const uint8_t *where, int64_t n, int64_t cap,
+                           double *samples, int64_t *meta) {
+    return sd_core(x, valid, where, n, cap, samples, meta, NULL, NULL, 0,
+                   NULL);
+}
+
+/* Combined family kernel: moments + decimated quantile sample in the
+ * same traversals. mom = [count, sum, min, max, m2, n_where] (the
+ * masked_moments contract); samples/meta as masked_select_decimate. */
+int masked_moments_select(const double *x, const uint8_t *valid,
+                          const uint8_t *where, int64_t n, int64_t cap,
+                          double *samples, int64_t *meta, double *mom,
+                          const int64_t *hashvals, int hll_mode,
+                          int32_t *regs) {
+    return sd_core(x, valid, where, n, cap, samples, meta, mom, hashvals,
+                   hll_mode, regs);
+}

@@ -265,7 +265,8 @@ def _save_bandwidth_to_disk(bandwidth: float) -> None:
         k: v
         for k, v in data.items()
         if isinstance(v, dict)
-        and now - float(v.get("ts", 0)) <= PLACEMENT_CACHE_TTL_S
+        and isinstance(v.get("ts"), (int, float))
+        and now - float(v["ts"]) <= PLACEMENT_CACHE_TTL_S
     }
     try:
         write_text_output(path, json.dumps(data), overwrite=True)

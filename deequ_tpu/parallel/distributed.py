@@ -29,6 +29,7 @@ from deequ_tpu.data.table import Table
 from deequ_tpu.ops import runtime
 from deequ_tpu.ops.fused import (
     AnalyzerRunResult,
+    HostInputs,
     PipelinedAggFold,
     _pad_size,
     fold_host_batch,
@@ -155,7 +156,12 @@ class DistributedScanPass:
                 continue
             for spec in analyzer_specs:
                 specs.setdefault(spec.key, spec)
-            if getattr(analyzer, "device_assisted", False) and not host_all:
+            host_only = getattr(analyzer, "host_only", False)
+            if (
+                getattr(analyzer, "device_assisted", False)
+                and not host_all
+                and not host_only
+            ):
                 assisted.append(analyzer)
                 assisted_idx.append(i)
                 device_keys.update(s.key for s in analyzer_specs)
@@ -195,31 +201,26 @@ class DistributedScanPass:
         host_assisted_states: Dict[int, Any] = {}
         host_errors: Dict[int, BaseException] = {}
         sticky: Dict[str, Any] = {}
+        streaming = bool(getattr(table, "is_streaming", False))
         try:
             fold = PipelinedAggFold(merge_analyzers, assisted, n_dev=n_devices)
 
             device_error: Any = None
             for batch in table.batches(global_batch):
                 # per-key builds with error capture — same isolation
-                # contract as FusedScanPass._run_pass
-                built: Dict[str, np.ndarray] = {}
-                build_errors: Dict[str, BaseException] = {}
-                live_keys: set = set()
+                # contract as FusedScanPass._run_pass; host-only keys
+                # build lazily (fused.HostInputs)
                 device_live = fn is not None and device_error is None
-                if device_live:
-                    live_keys.update(device_keys)
-                host_live = False
-                for i, _m in host_members + host_assisted:
-                    if i not in host_errors:
-                        host_live = True
-                        live_keys.update(host_member_keys[i])
+                host_live = any(
+                    i not in host_errors for i, _m in host_members + host_assisted
+                )
                 if not device_live and not host_live:
                     break  # everything already failed; stop scanning
-                for key in sorted(live_keys):
-                    try:
-                        built[key] = np.asarray(specs[key].build(batch))
-                    except Exception as e:  # noqa: BLE001
-                        build_errors[key] = e
+                built = HostInputs(specs, batch)
+                build_errors = built.build_errors
+                if device_live:
+                    for key in sorted(device_keys):
+                        built.materialize(key)
                 if fn is not None and device_error is None:
                     try:
                         for key in device_keys:
@@ -249,6 +250,7 @@ class DistributedScanPass:
                     built, build_errors, host_members, host_assisted,
                     host_member_keys, host_aggs, host_assisted_states,
                     host_errors,
+                    batch=batch, streaming=streaming,
                 )
             aggs, assisted_states = [], []
             if device_error is None:

@@ -139,6 +139,19 @@ class ColumnProfiler:
                 "### PROFILING: Computing generic column statistics in "
                 f"pass (1/{total_passes})..."
             )
+        from deequ_tpu.profiles.internal_analyzers import (
+            LowCardCountsState,
+            OptimisticNumericState,
+            _LowCardCounts,
+            _OptimisticNumericStats,
+            synthesize_numeric_metrics,
+        )
+
+        # optimistic members fold passes 2 and 3 into pass 1 (see
+        # internal_analyzers module docstring); the count cap leaves HLL
+        # estimation error (rsd 0.05) generous headroom over the
+        # histogram threshold
+        lcc_cap = max(4 * low_cardinality_histogram_threshold, 256)
         analyzers_pass1 = [Size()]
         for name in relevant:
             analyzers_pass1.append(Completeness(name))
@@ -146,6 +159,10 @@ class ColumnProfiler:
             ctype = data.column(name).ctype
             if ctype == ColumnType.STRING:
                 analyzers_pass1.append(DataType(name))
+                analyzers_pass1.append(_LowCardCounts(name, lcc_cap))
+                analyzers_pass1.append(_OptimisticNumericStats(name))
+            elif ctype == ColumnType.BOOLEAN:
+                analyzers_pass1.append(_LowCardCounts(name, lcc_cap))
             elif ctype.is_numeric:
                 analyzers_pass1.extend(_numeric_stat_analyzers(name))
 
@@ -156,6 +173,22 @@ class ColumnProfiler:
         ).run()
 
         generic_stats = _extract_generic_statistics(relevant, data, results_pass1)
+        low_card_counts: Dict[str, LowCardCountsState] = {}
+        optimistic_numeric: Dict[str, OptimisticNumericState] = {}
+        for analyzer, metric in results_pass1.metric_map.items():
+            if not metric.value.is_success:
+                continue
+            state = metric.value.get()
+            if isinstance(analyzer, _LowCardCounts) and isinstance(
+                state, LowCardCountsState
+            ):
+                if not state.aborted:
+                    low_card_counts[analyzer.column] = state
+            elif isinstance(analyzer, _OptimisticNumericStats) and isinstance(
+                state, OptimisticNumericState
+            ):
+                if state.usable:
+                    optimistic_numeric[analyzer.column] = state
 
         # ---- Pass 2 (reference: :128-153, cast at :399-417) --------------
         # runs ONLY for inferred-numeric STRING columns, which need the
@@ -169,10 +202,38 @@ class ColumnProfiler:
         cast_columns = [
             name for name in numeric_columns if name in generic_stats.inferred_types
         ]
+        combined = results_pass1
+        # optimistic pass-1 stats replace pass 2 for columns where they
+        # survived (every value cast cleanly — guaranteed whenever
+        # inference landed numeric, see internal_analyzers). With a reuse
+        # key the classic pass keeps its repository short-circuit
+        # semantics instead.
+        synthesized: Dict = {}
+        if reuse_existing_results_for_key is None:
+            for name in list(cast_columns):
+                state = optimistic_numeric.get(name)
+                if state is not None:
+                    synthesized.update(
+                        synthesize_numeric_metrics(name, state, _PERCENTILES)
+                    )
+                    cast_columns.remove(name)
+        if synthesized:
+            from deequ_tpu.runners.context import AnalyzerContext
+
+            synthesized_ctx = AnalyzerContext(synthesized)
+            combined = combined + synthesized_ctx
+            if (
+                metrics_repository is not None
+                and save_in_metrics_repository_using_key is not None
+            ):
+                AnalysisRunner._save_or_append(
+                    metrics_repository,
+                    save_in_metrics_repository_using_key,
+                    synthesized_ctx,
+                )
         analyzers_pass2 = []
         for name in cast_columns:
             analyzers_pass2.extend(_numeric_stat_analyzers(name))
-        combined = results_pass1
         if analyzers_pass2:
             if print_status_updates:
                 print(
@@ -192,15 +253,35 @@ class ColumnProfiler:
         numeric_stats = _extract_numeric_statistics(combined)
 
         # ---- Pass 3 (reference: :487-565) --------------------------------
-        if print_status_updates:
-            print(
-                "### PROFILING: Computing histograms of low-cardinality "
-                f"columns in pass ({total_passes}/{total_passes})..."
-            )
+        # Normally already answered by the pass-1 _LowCardCounts fold; a
+        # separate counting pass runs only for stragglers (column whose
+        # exact distinct blew the optimistic cap while its HLL estimate
+        # still cleared the threshold — possible but rare at rsd 0.05).
         target_columns = _find_target_columns_for_histograms(
             data, generic_stats, low_cardinality_histogram_threshold
         )
-        histograms = _compute_histograms(data, target_columns, generic_stats.num_records)
+        histograms: Dict[str, Distribution] = {}
+        stragglers = []
+        for name in target_columns:
+            state = low_card_counts.get(name)
+            if state is None:
+                stragglers.append(name)
+                continue
+            histograms[name] = _distribution_from_counts(
+                data.column(name).ctype,
+                state.as_dict(),
+                state.null_count,
+                generic_stats.num_records,
+            )
+        if stragglers:
+            if print_status_updates:
+                print(
+                    "### PROFILING: Computing histograms of low-cardinality "
+                    f"columns in pass ({total_passes}/{total_passes})..."
+                )
+            histograms.update(
+                _compute_histograms(data, stragglers, generic_stats.num_records)
+            )
 
         return _create_profiles(relevant, generic_stats, numeric_stats, histograms)
 
@@ -337,6 +418,33 @@ def _find_target_columns_for_histograms(
         if count <= threshold:
             out.append(name)
     return out
+
+
+def _distribution_from_counts(
+    ctype: ColumnType,
+    counts: Dict,
+    null_count: int,
+    num_records: int,
+) -> Distribution:
+    """Shared rendering of exact value counts into the reference's
+    Distribution shape (null bucket name 'NullValue', booleans as
+    'true'/'false' — reference: Histogram.scala:108, ColumnProfiler.scala
+    :523-565)."""
+    values: Dict[str, DistributionValue] = {}
+    if null_count > 0:
+        values["NullValue"] = DistributionValue(
+            null_count, null_count / num_records
+        )
+    for unique, count in counts.items():
+        if ctype == ColumnType.BOOLEAN:
+            key = "true" if unique else "false"
+        else:
+            key = str(unique)
+        prev = values.get(key)
+        if prev is not None:
+            count = count + prev.absolute
+        values[key] = DistributionValue(count, count / num_records)
+    return Distribution(values, number_of_bins=len(values))
 
 
 def _compute_histograms(

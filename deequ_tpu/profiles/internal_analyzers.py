@@ -1,0 +1,466 @@
+"""Profiler-internal scan members: fold pass 3 (and usually pass 2) into
+pass 1.
+
+The reference's ColumnProfiler pays 3 scans: generic stats, numeric stats
+for cast columns, low-cardinality histograms
+(reference: profiles/ColumnProfiler.scala:54-65, 103-187). These two
+host-only scan-shareable members ride pass 1's fused scan instead:
+
+- `_LowCardCounts` counts exact values for a string/bool column while its
+  dict codes are hot (the pass-3 work), aborting once the running distinct
+  count exceeds a cap — the profiler only keeps histograms for columns
+  whose approx distinct is under the threshold anyway.
+- `_OptimisticNumericStats` computes the full pass-2 numeric bundle
+  (min/max/mean/stddev/sum + quantile sketch) for a STRING column under
+  the optimistic assumption that type inference will land
+  Integral/Fractional. This is sound: `determine_type` (reference:
+  analyzers/DataType.scala:116-146) returns a numeric type only when NO
+  value classified as String, i.e. every value matched a numeric regex —
+  so a numeric verdict implies every batch was fully castable and the
+  optimistic stats equal what pass 2 would have computed. Any parse
+  failure kills the optimistic state (`dead`) and the final type cannot
+  be numeric; if inference and castability ever disagree (pathological
+  forms like "+ 5" that match the regex but not float()), the profiler
+  simply falls back to a real pass 2 for that column.
+
+Both are `internal`: their metrics never reach a MetricsRepository
+(AnalysisRunner._save_or_append filters them), and they are host_only —
+strings and dict codes never ship to the device.
+
+A streamed profile with these members on board decodes the input ONCE
+for the whole profile (the round-3 verdict's single-decode demand).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deequ_tpu.analyzers.base import (
+    InputSpec,
+    Preconditions,
+    ScanShareableAnalyzer,
+)
+from deequ_tpu.analyzers.sketch import ApproxQuantileState, _next_batch_seed
+from deequ_tpu.analyzers.states import State
+from deequ_tpu.core.maybe import Success
+from deequ_tpu.core.metrics import Entity, Metric
+from deequ_tpu.data.table import Table
+from deequ_tpu.ops.sketches.kll import KLLSketch, k_for_error
+
+
+@dataclass(frozen=True)
+class _InternalStateMetric(Metric):
+    """Carries a raw state through the runner's metric map; internal-only
+    (filtered from repositories, never serialized)."""
+
+    def flatten(self):
+        return []
+
+
+def _internal_metric(name: str, instance: str, value) -> "_InternalStateMetric":
+    return _InternalStateMetric(Entity.COLUMN, name, instance, value)
+
+
+# ---------------------------------------------------------------------------
+# _LowCardCounts: exact value counts while the dict codes are hot
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class LowCardCountsState(State):
+    """counts[value] over non-null rows + null count; aborted=True once
+    the RUNNING distinct count exceeded the cap (histogram not wanted
+    for such columns anyway). The cap travels with the state so merges
+    enforce it too: a stream whose batches each stay under the cap but
+    whose cumulative dictionary does not still aborts instead of
+    growing without bound."""
+
+    counts: Tuple[Tuple[Any, int], ...]
+    null_count: int
+    aborted: bool
+    cap: int = 1 << 30
+
+    def merge(self, other: "LowCardCountsState") -> "LowCardCountsState":
+        cap = min(self.cap, other.cap)
+        if self.aborted or other.aborted:
+            return LowCardCountsState(
+                (), self.null_count + other.null_count, True, cap
+            )
+        merged: Dict[Any, int] = dict(self.counts)
+        for key, count in other.counts:
+            merged[key] = merged.get(key, 0) + count
+        if len(merged) > cap:
+            return LowCardCountsState(
+                (), self.null_count + other.null_count, True, cap
+            )
+        return LowCardCountsState(
+            tuple(merged.items()), self.null_count + other.null_count, False, cap
+        )
+
+    def as_dict(self) -> Dict[Any, int]:
+        return dict(self.counts)
+
+
+@dataclass(frozen=True)
+class _LowCardCounts(ScanShareableAnalyzer):
+    """Pass-3 exact histogram counting fused into pass 1
+    (reference: profiles/ColumnProfiler.scala:487-565 — the rdd
+    countByKey pass this replaces)."""
+
+    column: str
+    cap: int
+    internal = True
+    device_assisted = True
+    host_only = True
+
+    @property
+    def name(self) -> str:
+        return "_LowCardCounts"
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    def preconditions(self) -> List[Callable[[Table], None]]:
+        return [Preconditions.has_column(self.column)]
+
+    def input_specs(self) -> List[InputSpec]:
+        from deequ_tpu.data.table import ColumnType
+
+        column = self.column
+
+        def build_codes(t: Table) -> np.ndarray:
+            col = t.column(column)
+            if col.ctype == ColumnType.BOOLEAN:
+                # bool fast path: raw values; counting is three popcounts,
+                # no dictionary encode (device_batch dispatches on dtype)
+                return col.values
+            codes, _ = col.dict_encode()
+            return codes
+
+        def build_uniques(t: Table) -> np.ndarray:
+            col = t.column(column)
+            if col.ctype == ColumnType.BOOLEAN:
+                return col.valid  # the bool path carries valid here
+            _, uniques = col.dict_encode()
+            return np.asarray(uniques)
+
+        return [
+            InputSpec(
+                key=f"lcc_codes:{column}", build=build_codes, columns=(column,)
+            ),
+            InputSpec(
+                key=f"lcc_uniq:{column}", build=build_uniques, columns=(column,)
+            ),
+        ]
+
+    def device_batch(self, inputs: Dict[str, Any], xp) -> Any:
+        from deequ_tpu.ops import native
+
+        codes = np.asarray(inputs[f"lcc_codes:{self.column}"])
+        uniques = inputs[f"lcc_uniq:{self.column}"]
+        if codes.dtype == np.bool_:
+            # bool fast path: codes = raw values, uniques slot = valid
+            valid = np.asarray(uniques)
+            n_true = int(np.count_nonzero(codes & valid))
+            n_valid = int(np.count_nonzero(valid))
+            counts = np.asarray(
+                [len(codes) - n_valid, n_valid - n_true, n_true],
+                dtype=np.int64,
+            )
+            return {
+                "counts": counts,
+                "uniques": np.asarray([False, True], dtype=object),
+            }
+        if len(uniques) > self.cap:
+            # this batch alone blows the cap: no histogram will be kept
+            # for the column, so skip the counting work entirely
+            return {"aborted": True}
+        counts = native.bincount(codes, len(uniques) + 1, base=1)
+        if counts is None:
+            counts = np.bincount(
+                codes + 1, minlength=len(uniques) + 1
+            ).astype(np.int64)
+        return {"counts": counts, "uniques": uniques}
+
+    def host_consume(self, state: Optional[State], out: Any) -> Optional[State]:
+        if out.get("aborted"):
+            partial = LowCardCountsState((), 0, True, self.cap)
+            return partial if state is None else state.merge(partial)
+        counts = np.asarray(out["counts"])
+        uniques = out["uniques"]
+        partial_counts = []
+        for i, unique in enumerate(uniques):
+            c = int(counts[i + 1])
+            if c > 0:
+                partial_counts.append((unique, c))
+        partial = LowCardCountsState(
+            tuple(partial_counts),
+            int(counts[0]),
+            len(partial_counts) > self.cap,
+            self.cap,
+        )
+        return partial if state is None else state.merge(partial)
+
+    def compute_metric_from(self, state: Optional[State]) -> Metric:
+        return _internal_metric(self.name, self.instance, Success(state))
+
+    def __repr__(self) -> str:
+        return f"_LowCardCounts({self.column},{self.cap})"
+
+
+# ---------------------------------------------------------------------------
+# _OptimisticNumericStats: the pass-2 numeric bundle, speculatively
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class OptimisticNumericState(State):
+    """The whole numeric-stat family for one cast column: moments
+    (merged with the same Chan law the scan analyzers use) + KLL digest.
+    dead=True once any non-null value failed to cast."""
+
+    n: float
+    total: float
+    minimum: float
+    maximum: float
+    m2: float
+    digest: Optional[KLLSketch]
+    dead: bool
+
+    def merge(self, other: "OptimisticNumericState") -> "OptimisticNumericState":
+        if self.dead or other.dead:
+            return OptimisticNumericState(
+                0.0, 0.0, float("inf"), float("-inf"), 0.0, None, True
+            )
+        n = self.n + other.n
+        safe_n = max(n, 1.0)
+        avg_a = self.total / max(self.n, 1.0)
+        avg_b = other.total / max(other.n, 1.0)
+        delta = avg_b - avg_a
+        m2 = self.m2 + other.m2 + delta * delta * self.n * other.n / safe_n
+        if self.digest is None:
+            digest = other.digest
+        elif other.digest is None:
+            digest = self.digest
+        else:
+            digest = self.digest.merge(other.digest)
+        return OptimisticNumericState(
+            n,
+            self.total + other.total,
+            min(self.minimum, other.minimum),
+            max(self.maximum, other.maximum),
+            m2,
+            digest,
+            False,
+        )
+
+    @property
+    def usable(self) -> bool:
+        return not self.dead and self.n > 0 and self.digest is not None
+
+
+_DEAD_SENTINEL = "__dead__"
+
+
+@dataclass(frozen=True)
+class _OptimisticNumericStats(ScanShareableAnalyzer):
+    """Pass-2 numeric statistics computed during pass 1 for a string
+    column that MAY infer numeric (reference:
+    profiles/ColumnProfiler.scala:128-153, 329-339 — the cast + numeric
+    pass this makes redundant when inference lands numeric)."""
+
+    column: str
+    relative_error: float = 0.01
+    internal = True
+    device_assisted = True
+    host_only = True
+
+    @property
+    def name(self) -> str:
+        return "_OptimisticNumericStats"
+
+    @property
+    def instance(self) -> str:
+        return self.column
+
+    def preconditions(self) -> List[Callable[[Table], None]]:
+        return [Preconditions.has_column(self.column)]
+
+    def _cap(self) -> int:
+        return 2 * k_for_error(self.relative_error)
+
+    def input_specs(self) -> List[InputSpec]:
+        column = self.column
+
+        def cast_or_dead(col):
+            """(values, cast_valid) or the dead sentinel — shared by both
+            specs through numeric_values' per-column memoization."""
+            _, uniques = col.dict_encode()
+            if len(uniques):
+                # cheap castability probe on the head of the dictionary:
+                # a clearly non-numeric column (names, UUIDs, ...) dies
+                # here without paying a full parse of its dictionary
+                from deequ_tpu.ops.strings import parse_floats
+
+                _, ok = parse_floats(np.asarray(uniques[:64], dtype=object))
+                if not ok.all():
+                    return None
+            values, cast_valid = col.numeric_values()
+            # rows that were present but failed to parse kill the state
+            if np.count_nonzero(np.asarray(col.valid) & ~np.asarray(cast_valid)):
+                return None
+            return values, cast_valid
+
+        def build_values(t: Table):
+            res = cast_or_dead(t.column(column))
+            if res is None:
+                return np.asarray(_DEAD_SENTINEL)
+            return np.asarray(res[0])
+
+        def build_valid(t: Table):
+            res = cast_or_dead(t.column(column))
+            if res is None:
+                return np.asarray(_DEAD_SENTINEL)
+            return np.asarray(res[1])
+
+        return [
+            InputSpec(
+                key=f"optnum:{column}", build=build_values, columns=(column,)
+            ),
+            InputSpec(
+                key=f"optnumv:{column}", build=build_valid, columns=(column,)
+            ),
+        ]
+
+    def device_batch(self, inputs: Dict[str, Any], xp) -> Any:
+        values = inputs[f"optnum:{self.column}"]
+        cast_valid = inputs[f"optnumv:{self.column}"]
+        if np.asarray(values).ndim == 0:
+            return {"dead": True}
+        from deequ_tpu.ops import native
+
+        cap = self._cap()
+        res = native.masked_moments_select(values, cast_valid, None, cap)
+        if res is not None:
+            mom, sample, n_valid, level, _regs = res
+            return {
+                "dead": False,
+                "count": float(mom[0]),
+                "sum": float(mom[1]),
+                "min": float(mom[2]),
+                "max": float(mom[3]),
+                "m2": float(mom[4]),
+                "sample": sample,
+                "n": n_valid,
+                "level": level,
+            }
+        # numpy fallback: same math, same decimation law
+        mask = np.asarray(cast_valid, dtype=bool)
+        xm = np.asarray(values, dtype=np.float64)[mask]
+        n = xm.size
+        if n == 0:
+            return {
+                "dead": False, "count": 0.0, "sum": 0.0,
+                "min": float("inf"), "max": float("-inf"), "m2": 0.0,
+                "sample": np.zeros(0), "n": 0, "level": 0,
+            }
+        avg = float(xm.sum()) / n
+        level = max(0, int(np.ceil(np.log2(max(n, 1) / cap))))
+        stride = 1 << level
+        xs = np.sort(xm)
+        kept = max(0, -(-(n - stride // 2) // stride))
+        return {
+            "dead": False,
+            "count": float(n),
+            "sum": float(xm.sum()),
+            "min": float(xs[0]),
+            "max": float(xs[-1]),
+            "m2": float(((xm - avg) ** 2).sum()),
+            "sample": xs[stride // 2 :: stride][:kept],
+            "n": n,
+            "level": level,
+        }
+
+    def host_consume(self, state: Optional[State], out: Any) -> Optional[State]:
+        if out.get("dead"):
+            partial = OptimisticNumericState(
+                0.0, 0.0, float("inf"), float("-inf"), 0.0, None, True
+            )
+        else:
+            digest = KLLSketch(
+                k=k_for_error(self.relative_error), seed=_next_batch_seed()
+            )
+            n = int(out["n"])
+            if n > 0:
+                level = int(out["level"])
+                stride = 1 << level
+                kept = max(0, -(-(n - stride // 2) // stride))
+                sample = np.asarray(out["sample"], dtype=np.float64)[:kept]
+                digest.insert_level(sample, level, true_count=n)
+            partial = OptimisticNumericState(
+                float(out["count"]),
+                float(out["sum"]),
+                float(out["min"]),
+                float(out["max"]),
+                float(out["m2"]),
+                digest,
+                False,
+            )
+        return partial if state is None else state.merge(partial)
+
+    def compute_metric_from(self, state: Optional[State]) -> Metric:
+        return _internal_metric(self.name, self.instance, Success(state))
+
+    def __repr__(self) -> str:
+        return f"_OptimisticNumericStats({self.column},{self.relative_error})"
+
+
+def synthesize_numeric_metrics(
+    column: str,
+    state: OptimisticNumericState,
+    percentiles,
+    relative_error: float = 0.01,
+) -> Dict[Any, Metric]:
+    """Build the EXACT metric map pass 2 would have produced for this
+    column, through the real analyzers' compute_metric_from — so shapes,
+    names and failure semantics are identical
+    (reference: ColumnProfiler.scala:219-235's analyzer bundle)."""
+    from deequ_tpu.analyzers import (
+        ApproxQuantiles,
+        Maximum,
+        Mean,
+        Minimum,
+        StandardDeviation,
+        Sum,
+    )
+    from deequ_tpu.analyzers.states import (
+        MaxState,
+        MeanState,
+        MinState,
+        StandardDeviationState,
+        SumState,
+    )
+
+    n = state.n
+    avg = state.total / max(n, 1.0)
+    out: Dict[Any, Metric] = {}
+    out[Minimum(column)] = Minimum(column).compute_metric_from(
+        MinState(state.minimum)
+    )
+    out[Maximum(column)] = Maximum(column).compute_metric_from(
+        MaxState(state.maximum)
+    )
+    out[Mean(column)] = Mean(column).compute_metric_from(
+        MeanState(state.total, int(n))
+    )
+    out[Sum(column)] = Sum(column).compute_metric_from(SumState(state.total))
+    out[StandardDeviation(column)] = StandardDeviation(column).compute_metric_from(
+        StandardDeviationState(n, avg, state.m2)
+    )
+    aq = ApproxQuantiles(column, tuple(percentiles), relative_error)
+    out[aq] = aq.compute_metric_from(ApproxQuantileState(state.digest))
+    return out

@@ -74,7 +74,14 @@ class AnalysisRunner:
                 }
                 reused = AnalyzerContext(reused_map)
             if fail_if_results_missing:
-                missing = [a for a in analyzers if a not in reused.metric_map]
+                # internal (profiler pass-fusion) analyzers are never
+                # repository-backed; their absence is not "missing"
+                missing = [
+                    a
+                    for a in analyzers
+                    if a not in reused.metric_map
+                    and not getattr(a, "internal", False)
+                ]
                 if missing:
                     raise RuntimeError(
                         "Could not find all necessary results in the "
@@ -222,7 +229,22 @@ class AnalysisRunner:
         key: "ResultKey",
         context: AnalyzerContext,
     ) -> None:
-        """Upsert semantics (reference: AnalysisRunner.scala:195-213)."""
+        """Upsert semantics (reference: AnalysisRunner.scala:195-213).
+        Internal analyzers (profiler pass-fusion members) never reach the
+        repository: their metrics carry raw states and have no serde."""
+        internal = [
+            a
+            for a in context.metric_map
+            if getattr(a, "internal", False)
+        ]
+        if internal:
+            context = AnalyzerContext(
+                {
+                    a: m
+                    for a, m in context.metric_map.items()
+                    if not getattr(a, "internal", False)
+                }
+            )
         existing = repository.load_by_key(key)
         combined = (existing + context) if existing is not None else context
         repository.save(key, combined)

@@ -119,12 +119,25 @@ def test_partition_merge_equals_whole_table(
     assert merged.is_success == whole.is_success, analyzer
     got, want = merged.get(), whole.get()
     if isinstance(analyzer, (ApproxQuantile, ApproxQuantiles)):
-        # sketches merged in a different order agree within rank error
+        # sketches merged in a different order agree within RANK error —
+        # the sketch's actual contract (value-space tolerances break down
+        # in distribution tails where the density is low)
+        xs = np.sort(WHOLE.column("x").values[WHOLE.column("x").valid])
+
+        def rank_of(v: float) -> float:
+            return float(np.searchsorted(xs, v, side="right")) / len(xs)
+
+        def assert_rank_close(g: float, w: float, q: float) -> None:
+            # each sketch answers within ~eps of q; allow both errors
+            budget = 3 * 0.01
+            assert abs(rank_of(g) - q) <= budget, (q, g, rank_of(g))
+            assert abs(rank_of(w) - q) <= budget, (q, w, rank_of(w))
+
         if isinstance(got, dict):
             for key in want:
-                assert got[key] == pytest.approx(want[key], rel=0.1), key
+                assert_rank_close(got[key], want[key], float(key))
         else:
-            assert got == pytest.approx(want, rel=0.1)
+            assert_rank_close(got, want, analyzer.quantile)
     elif hasattr(want, "values"):  # Distribution
         assert {k: v.absolute for k, v in got.values.items()} == {
             k: v.absolute for k, v in want.values.items()
