@@ -270,6 +270,22 @@ def where_key(where: Optional[str]) -> str:
     return f"where:{where}" if where is not None else "where:<all>"
 
 
+_ALL_TRUE_CACHE: dict = {}
+
+
+def _all_true(n: int) -> np.ndarray:
+    """Shared all-true mask per batch length (READ-ONLY: consumers treat
+    masks as immutable); saves one 1-byte-per-row allocation per batch."""
+    mask = _ALL_TRUE_CACHE.get(n)
+    if mask is None:
+        mask = np.ones(n, dtype=np.bool_)
+        mask.setflags(write=False)
+        if len(_ALL_TRUE_CACHE) >= 4:  # a scan sees at most a few sizes
+            _ALL_TRUE_CACHE.pop(next(iter(_ALL_TRUE_CACHE)))
+        _ALL_TRUE_CACHE[n] = mask
+    return mask
+
+
 def where_spec(where: Optional[str]) -> InputSpec:
     """Row mask for an optional filter; None = all (real) rows. Padding rows
     are False either way (the conditionalSelection analogue,
@@ -277,7 +293,7 @@ def where_spec(where: Optional[str]) -> InputSpec:
     if where is None:
         return InputSpec(
             key=where_key(None),
-            build=lambda t: np.ones(t.num_rows, dtype=np.bool_),
+            build=lambda t: _all_true(t.num_rows),
             columns=(),
         )
     pred = Predicate(where)
@@ -329,6 +345,21 @@ class ScanShareableAnalyzer(Analyzer):
         the traced cross-device mesh merge (xp=jnp) and the driver-side
         float64 cross-batch fold (xp=numpy)."""
         raise NotImplementedError
+
+    def unshift_agg(self, agg: Any, shifts: Dict[str, float]) -> Any:
+        """Undo the f32 wire's per-column pre-centering (the engine ships
+        x - shift so a float32 device resolves clustered data, e.g. mean
+        ~1e7 with variance ~1e-2 — without the shift the variance signal
+        is destroyed by f32 quantization before any kernel runs). Called
+        once on the final aggregate; `shifts` maps input keys
+        ("num:<col>") to the scan-constant shift. Default: no numeric
+        value inputs, nothing to undo."""
+        return agg
+
+    def unshift_batch(self, out: Any, shifts: Dict[str, float]) -> Any:
+        """Same, for a device-assisted member's per-batch output (applied
+        before host_consume)."""
+        return out
 
     def state_from_aggregates(self, agg: Any) -> Optional[State]:
         """Folded (host, float64) pytree -> State; None = empty state."""

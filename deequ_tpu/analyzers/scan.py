@@ -91,6 +91,9 @@ class Size(ScanShareableAnalyzer):
         return [where_spec(self.where)]
 
     def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        if xp is np and self.where is None:
+            # host fold: unfiltered size is the (unpadded) batch length
+            return {"n": float(inputs[where_key(None)].shape[0])}
         w = inputs[where_key(self.where)]
         if xp is np and np.asarray(w).dtype == np.bool_:
             return {"n": float(np.count_nonzero(w))}  # host fold fast path
@@ -515,6 +518,12 @@ class Mean(_NumericScanAnalyzer):
     def merge_agg(self, a: Any, b: Any, xp) -> Any:
         return {"total": a["total"] + b["total"], "count": a["count"] + b["count"]}
 
+    def unshift_agg(self, agg: Any, shifts: Dict[str, float]) -> Any:
+        s = shifts.get(f"num:{self.column}", 0.0)
+        if s == 0.0:
+            return agg
+        return {"total": agg["total"] + s * agg["count"], "count": agg["count"]}
+
     def state_from_aggregates(self, agg: Any) -> Optional[State]:
         if int(agg["count"]) == 0:
             return None
@@ -544,6 +553,12 @@ class Sum(_NumericScanAnalyzer):
 
     def merge_agg(self, a: Any, b: Any, xp) -> Any:
         return {"sum": a["sum"] + b["sum"], "count": a["count"] + b["count"]}
+
+    def unshift_agg(self, agg: Any, shifts: Dict[str, float]) -> Any:
+        s = shifts.get(f"num:{self.column}", 0.0)
+        if s == 0.0:
+            return agg
+        return {"sum": agg["sum"] + s * agg["count"], "count": agg["count"]}
 
     def state_from_aggregates(self, agg: Any) -> Optional[State]:
         if int(agg["count"]) == 0:
@@ -576,6 +591,12 @@ class Minimum(_NumericScanAnalyzer):
     def merge_agg(self, a: Any, b: Any, xp) -> Any:
         return {"min": xp.minimum(a["min"], b["min"]), "count": a["count"] + b["count"]}
 
+    def unshift_agg(self, agg: Any, shifts: Dict[str, float]) -> Any:
+        s = shifts.get(f"num:{self.column}", 0.0)
+        if s == 0.0:
+            return agg
+        return {"min": agg["min"] + s, "count": agg["count"]}
+
     def state_from_aggregates(self, agg: Any) -> Optional[State]:
         if int(agg["count"]) == 0:
             return None
@@ -606,6 +627,12 @@ class Maximum(_NumericScanAnalyzer):
 
     def merge_agg(self, a: Any, b: Any, xp) -> Any:
         return {"max": xp.maximum(a["max"], b["max"]), "count": a["count"] + b["count"]}
+
+    def unshift_agg(self, agg: Any, shifts: Dict[str, float]) -> Any:
+        s = shifts.get(f"num:{self.column}", 0.0)
+        if s == 0.0:
+            return agg
+        return {"max": agg["max"] + s, "count": agg["count"]}
 
     def state_from_aggregates(self, agg: Any) -> Optional[State]:
         if int(agg["count"]) == 0:
@@ -654,6 +681,12 @@ class StandardDeviation(_NumericScanAnalyzer):
         avg = (a["n"] * a["avg"] + b["n"] * b["avg"]) / safe_n
         m2 = a["m2"] + b["m2"] + delta * delta * a["n"] * b["n"] / safe_n
         return {"n": n, "avg": xp.where(n > 0, avg, 0.0), "m2": m2}
+
+    def unshift_agg(self, agg: Any, shifts: Dict[str, float]) -> Any:
+        s = shifts.get(f"num:{self.column}", 0.0)
+        if s == 0.0:
+            return agg
+        return {"n": agg["n"], "avg": agg["avg"] + s, "m2": agg["m2"]}
 
     def state_from_aggregates(self, agg: Any) -> Optional[State]:
         if float(agg["n"]) == 0:
@@ -742,6 +775,16 @@ class Correlation(ScanShareableAnalyzer):
             "x_mk": a["x_mk"] + b["x_mk"] + dx * dx * cross,
             "y_mk": a["y_mk"] + b["y_mk"] + dy * dy * cross,
         }
+
+    def unshift_agg(self, agg: Any, shifts: Dict[str, float]) -> Any:
+        sx = shifts.get(f"num:{self.first_column}", 0.0)
+        sy = shifts.get(f"num:{self.second_column}", 0.0)
+        if sx == 0.0 and sy == 0.0:
+            return agg
+        out = dict(agg)
+        out["x_avg"] = agg["x_avg"] + sx
+        out["y_avg"] = agg["y_avg"] + sy
+        return out
 
     def state_from_aggregates(self, agg: Any) -> Optional[State]:
         if float(agg["n"]) == 0:

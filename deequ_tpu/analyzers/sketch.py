@@ -139,6 +139,22 @@ class ApproxCountDistinct(ScanShareableAnalyzer):
             regs = inputs.get(f"__hllregs:{self.column}:{where_key(self.where)}")
             if regs is not None:
                 return {"registers": np.asarray(regs)}
+            if self.where is None:
+                # a string column whose dictionary presence was counted
+                # this batch (_LowCardCounts): hash only the PRESENT
+                # uniques — identical registers, no full-row scatter
+                pres = inputs.get(f"__lccpresence:{self.column}")
+                if pres is not None:
+                    from deequ_tpu.ops.strings import hash_strings
+
+                    present, uniques = pres
+                    hashes = hash_strings(
+                        np.asarray(uniques, dtype=object)[np.asarray(present)]
+                    )
+                    idx, rank = hll.registers_from_hashes(hashes)
+                    registers = np.zeros(hll.M, dtype=np.int32)
+                    np.maximum.at(registers, idx, rank.astype(np.int32))
+                    return {"registers": registers}
         packed = xp.asarray(inputs[f"hll:{self.column}"])
         w = inputs[where_key(self.where)]
         if xp is np:
@@ -375,6 +391,12 @@ class _QuantileAnalyzerBase(ScanShareableAnalyzer):
             "n": n[None] if hasattr(n, "shape") else xp.asarray([n]),
             "level": level[None].astype(xp.int32),
         }
+
+    def unshift_batch(self, out: Any, shifts) -> Any:
+        s = shifts.get(f"num:{self.column}", 0.0)
+        if s == 0.0:
+            return out
+        return {**out, "sample": np.asarray(out["sample"], dtype=np.float64) + s}
 
     def host_consume(self, state: Optional[State], out: Any) -> Optional[State]:
         n = int(round(float(np.asarray(out["n"]).reshape(-1)[0])))

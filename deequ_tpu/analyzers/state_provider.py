@@ -3,11 +3,12 @@
 reference: analyzers/StateProvider.scala:36-295. The filesystem provider
 keeps the reference's binary layouts (big-endian, Java DataOutputStream
 conventions) per analyzer type, so the *payload* of a state file is
-format-compatible where the underlying sketch is. File *naming* is not
-interoperable: files are keyed by SHA-1[:16] of repr(analyzer), whereas
-the reference keys by MurmurHash3(analyzer.toString)
-(StateProvider.scala:81-83) — a state written by one implementation is
-not discovered by the other without renaming.
+format-compatible where the underlying sketch is. File *naming* defaults
+to SHA-1[:16] of repr(analyzer) (this build's stable scheme);
+`naming="reference"` switches to the reference's
+MurmurHash3(analyzer.toString) scheme (StateProvider.scala:81-83) so the
+two implementations can discover each other's files — see README
+'State-file interop' for the JVM-validation caveat.
 
 CAUTION on sketch states across engine versions: HLL registers are a
 function of the engine's value hash. If the hash changes between builds
@@ -65,15 +66,86 @@ class InMemoryStateProvider(StateLoader, StatePersister):
         return f"InMemoryStateProvider({keys})"
 
 
+def _scala_murmur3_string_hash(s: str) -> int:
+    """scala.util.hashing.MurmurHash3.stringHash(s) — the hash the
+    reference uses to name state files
+    (reference: analyzers/StateProvider.scala:81-83). Characters are
+    consumed in UTF-16 code-unit pairs ((c[i] << 16) | c[i+1]) with the
+    stringSeed 0xf7ca7fd2, then the standard murmur3 x86_32
+    finalization. Implemented from the published algorithm; there is no
+    JVM in this image to cross-validate against, so reference-side
+    interop should be smoke-tested once before relying on it (see
+    README 'State-file interop')."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    mask = 0xFFFFFFFF
+
+    def rotl(value: int, amount: int) -> int:
+        return ((value << amount) | (value >> (32 - amount))) & mask
+
+    h = 0xF7CA7FD2  # MurmurHash3.stringSeed
+    # Java charAt/length operate on UTF-16 CODE UNITS: derive them
+    # explicitly so non-BMP characters (surrogate pairs on the JVM)
+    # hash identically
+    raw = s.encode("utf-16-be", "surrogatepass")
+    units = [
+        (raw[i] << 8) | raw[i + 1] for i in range(0, len(raw), 2)
+    ]
+    i = 0
+    while i + 1 < len(units):
+        data = ((units[i] << 16) | units[i + 1]) & mask
+        k = (data * c1) & mask
+        k = rotl(k, 15)
+        k = (k * c2) & mask
+        h ^= k
+        h = rotl(h, 13)
+        h = (h * 5 + 0xE6546B64) & mask
+        i += 2
+    if i < len(units):
+        k = (units[i] * c1) & mask
+        k = rotl(k, 15)
+        k = (k * c2) & mask
+        h ^= k
+    h ^= len(units)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & mask
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & mask
+    h ^= h >> 16
+    # Scala's Int is signed
+    return h - (1 << 32) if h >= (1 << 31) else h
+
+
 class FileSystemStateProvider(StateLoader, StatePersister):
     """Binary per-analyzer state files
-    (reference: HdfsStateProvider, StateProvider.scala:72-295)."""
+    (reference: HdfsStateProvider, StateProvider.scala:72-295).
 
-    def __init__(self, location_prefix: str, allow_overwrite: bool = False):
+    `filesystem` selects the storage backend (core/fsio.py — local disk,
+    in-memory object-store fake, or any fsspec store). `naming` selects
+    the file-name scheme: 'sha1' (default, this build's own stable
+    naming) or 'reference' (MurmurHash3 of the analyzer's toString, the
+    reference's scheme — lets the two implementations discover each
+    other's state files when the payload layouts already match
+    byte-for-byte)."""
+
+    def __init__(
+        self,
+        location_prefix: str,
+        allow_overwrite: bool = False,
+        filesystem=None,
+        naming: str = "sha1",
+    ):
+        from deequ_tpu.core.fsio import resolve_filesystem
+
+        if naming not in ("sha1", "reference"):
+            raise ValueError(f"naming must be 'sha1' or 'reference', got {naming!r}")
         self.location_prefix = location_prefix
         self.allow_overwrite = allow_overwrite
+        self.filesystem = resolve_filesystem(filesystem)
+        self.naming = naming
 
     def _identifier(self, analyzer: "Analyzer") -> str:
+        if self.naming == "reference":
+            return str(_scala_murmur3_string_hash(repr(analyzer)))
         digest = hashlib.sha1(repr(analyzer).encode("utf-8")).hexdigest()[:16]
         return digest
 
@@ -112,19 +184,15 @@ class FileSystemStateProvider(StateLoader, StatePersister):
 
     def _write(self, identifier: str, payload: bytes) -> None:
         path = self._path(identifier)
-        if os.path.exists(path) and not self.allow_overwrite:
+        if self.filesystem.exists(path) and not self.allow_overwrite:
             raise FileExistsError(f"File {path} already exists and overwrite disabled")
-        directory = os.path.dirname(os.path.abspath(path)) or "."
-        os.makedirs(directory, exist_ok=True)
-        with open(path, "wb") as f:
-            f.write(payload)
+        self.filesystem.write_bytes(path, payload)
 
     def _read(self, identifier: str) -> Optional[bytes]:
         path = self._path(identifier)
-        if not os.path.exists(path):
+        if not self.filesystem.exists(path):
             return None
-        with open(path, "rb") as f:
-            return f.read()
+        return self.filesystem.read_bytes(path)
 
     def _persist_frequencies(self, identifier: str, state) -> None:
         """Frequencies as Parquet + numRows binary
@@ -140,64 +208,68 @@ class FileSystemStateProvider(StateLoader, StatePersister):
         }
         if not self.allow_overwrite:
             for path in paths.values():
-                if os.path.exists(path):
+                if self.filesystem.exists(path):
                     raise FileExistsError(
                         f"File {path} already exists and overwrite disabled"
                     )
-        directory = os.path.dirname(os.path.abspath(paths["-frequencies.pqt"])) or "."
-        os.makedirs(directory, exist_ok=True)
 
-        # write siblings first, parquet last via tmp+rename: load() keys on
-        # the .pqt, so a crash mid-persist leaves a state that reads as
-        # absent, never corrupt
-        with open(paths["-num_rows.bin"], "wb") as f:
-            f.write(struct.pack(">q", state.num_rows))
-        with open(paths["-columns.txt"], "w", encoding="utf-8") as f:
-            f.write("\n".join(state.columns))
-        tmp = paths["-frequencies.pqt"] + ".tmp"
-        if getattr(state, "is_spilled", False):
-            # disk-spilled state streams partition by partition into the
-            # same Parquet layout (one row group per partition) — persist
-            # never materializes the full key set
-            writer = None
-            for part in state.partitions():
-                at = pa.table(_frequencies_to_columns(part))
+        # write siblings first, parquet last with atomic publish: load()
+        # keys on the .pqt, so a crash mid-persist leaves a state that
+        # reads as absent, never corrupt
+        self.filesystem.write_bytes(
+            paths["-num_rows.bin"], struct.pack(">q", state.num_rows)
+        )
+        self.filesystem.write_bytes(
+            paths["-columns.txt"], "\n".join(state.columns).encode("utf-8")
+        )
+        with self.filesystem.open_write(paths["-frequencies.pqt"]) as sink:
+            if getattr(state, "is_spilled", False):
+                # disk-spilled state streams partition by partition into
+                # the same Parquet layout (one row group per partition) —
+                # persist never materializes the full key set
+                writer = None
+                for part in state.partitions():
+                    at = pa.table(_frequencies_to_columns(part))
+                    if writer is None:
+                        writer = pq.ParquetWriter(sink, at.schema)
+                    writer.write_table(at)
                 if writer is None:
-                    writer = pq.ParquetWriter(tmp, at.schema)
-                writer.write_table(at)
-            if writer is None:
-                pq.write_table(
-                    pa.table(
-                        {
-                            **{name: [] for name in state.columns},
-                            COUNT_COL: np.array([], dtype=np.int64),
-                        }
-                    ),
-                    tmp,
-                )
+                    pq.write_table(
+                        pa.table(
+                            {
+                                **{name: [] for name in state.columns},
+                                COUNT_COL: np.array([], dtype=np.int64),
+                            }
+                        ),
+                        sink,
+                    )
+                else:
+                    writer.close()
             else:
-                writer.close()
-        else:
-            pq.write_table(pa.table(_frequencies_to_columns(state)), tmp)
-        os.replace(tmp, paths["-frequencies.pqt"])
+                pq.write_table(pa.table(_frequencies_to_columns(state)), sink)
 
     def _load_frequencies(self, identifier: str):
         import pyarrow.parquet as pq
 
         pqt_path = self._path(identifier, "-frequencies.pqt")
-        if not os.path.exists(pqt_path):
+        if not self.filesystem.exists(pqt_path):
             return None
-        with open(self._path(identifier, "-columns.txt"), encoding="utf-8") as f:
-            columns = [line for line in f.read().split("\n") if line]
-        with open(self._path(identifier, "-num_rows.bin"), "rb") as f:
-            (num_rows,) = struct.unpack(">q", f.read())
+        columns_payload = self.filesystem.read_bytes(
+            self._path(identifier, "-columns.txt")
+        ).decode("utf-8")
+        columns = [line for line in columns_payload.split("\n") if line]
+        (num_rows,) = struct.unpack(
+            ">q", self.filesystem.read_bytes(self._path(identifier, "-num_rows.bin"))
+        )
         # load row group by row group through the group-cap accumulator:
         # a persisted high-cardinality state comes back SPILLED, keeping
         # the persist/load round trip bounded-memory on both halves
         from deequ_tpu.analyzers.freq_spill import GroupCountAccumulator
 
         acc = GroupCountAccumulator(columns)
-        with pq.ParquetFile(pqt_path) as pf:
+        with self.filesystem.open_read(pqt_path) as source, pq.ParquetFile(
+            source
+        ) as pf:
             for g in range(pf.metadata.num_row_groups):
                 partial = _frequencies_from_table(
                     pf.read_row_group(g), columns, 0

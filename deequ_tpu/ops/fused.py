@@ -148,6 +148,41 @@ def get_fused_fn(
     return cached
 
 
+def resolve_shift(key: str, arr: np.ndarray, sticky, lookup) -> float:
+    """Scan-constant pre-centering shift for a num: wire key. Picked
+    from the first VALID row (null slots are 0.0-filled and would
+    otherwise silently disable the centering); recorded sticky so every
+    batch of the pass ships the same shift."""
+    shift_key = f"shift:{key}"
+    shift = sticky.get(shift_key)
+    if shift is None:
+        shift = 0.0
+        valid = lookup(f"valid:{key[len('num:'):]}") if key.startswith("num:") else None
+        if valid is not None:
+            valid = np.asarray(valid, dtype=bool)
+            first = np.flatnonzero(valid)[:1]
+            if first.size:
+                candidate = float(arr[int(first[0])])
+                if np.isfinite(candidate):
+                    shift = candidate
+        else:
+            finite = arr[np.isfinite(arr)]
+            if finite.size:
+                shift = float(finite[0])
+        sticky[shift_key] = shift
+    return shift
+
+
+def wire_shifts(sticky) -> Dict[str, float]:
+    """The f32 wire's per-column pre-centering shifts recorded by
+    pack_batch_inputs, keyed by input key (empty on the f64 wire)."""
+    return {
+        key[len("shift:"):]: value
+        for key, value in sticky.items()
+        if key.startswith("shift:") and value != 0.0
+    }
+
+
 def pack_batch_inputs(built_items, padded: int, dtype, sticky=None, num_rows=None):
     """Build the minimal wire format for one batch.
 
@@ -170,6 +205,11 @@ def pack_batch_inputs(built_items, padded: int, dtype, sticky=None, num_rows=Non
     """
     if sticky is None:
         sticky = {}
+    _built_map = {k: a for k, a in built_items}
+
+    def _built_lookup(key: str):
+        return _built_map.get(key)
+
     entries_by_group: Dict[tuple, List[tuple]] = {}
     const_keys: List[str] = []
     for key, arr in built_items:
@@ -193,6 +233,15 @@ def pack_batch_inputs(built_items, padded: int, dtype, sticky=None, num_rows=Non
                 (key, "int", arr)
             )
         else:
+            if np.dtype(dtype) == np.float32 and key.startswith("num:"):
+                # pre-center before the f32 cast: clustered data (mean
+                # ~1e7, variance ~1e-2) would otherwise lose its entire
+                # variance signal to f32 quantization ON THE WIRE. The
+                # shift is scan-constant (sticky) so cross-batch merges
+                # stay valid; analyzers undo it via unshift_agg/_batch.
+                shift = resolve_shift(key, arr, sticky, _built_lookup)
+                if shift != 0.0:
+                    arr = np.asarray(arr, dtype=np.float64) - shift
             arr = arr.astype(dtype, copy=False)
             entries_by_group.setdefault((np.dtype(dtype).name, "val"), []).append(
                 (key, "val", arr)
@@ -328,19 +377,16 @@ def fold_host_batch(
     numpy; assisted members (sketches) run the SAME per-batch computation
     the device would (sort+decimate) and fold via host_consume. A failed
     input fails only the members that need it."""
-    _precompute_family_kernels(built, host_assisted, batch if streaming else None)
-    for i, member in host_members:
-        if i in host_errors:
-            continue
-        try:
-            for key in host_member_keys[i]:
-                if key in build_errors:
-                    raise build_errors[key]
-            agg = _to_f64(member.device_reduce(built, np))
-            prev = host_aggs.get(i)
-            host_aggs[i] = agg if prev is None else member.merge_agg(prev, agg, np)
-        except Exception as e:  # noqa: BLE001
-            host_errors[i] = e
+    _precompute_family_kernels(
+        built,
+        host_assisted,
+        batch if streaming else None,
+        host_members=host_members,
+        host_errors=host_errors,
+    )
+    # assisted members fold FIRST: some publish per-batch memos that
+    # merge members answer from (e.g. _LowCardCounts' dictionary
+    # presence serving ApproxCountDistinct)
     for i, member in host_assisted:
         if i in host_errors:
             continue
@@ -352,6 +398,18 @@ def fold_host_batch(
             host_assisted_states[i] = member.host_consume(
                 host_assisted_states.get(i), out
             )
+        except Exception as e:  # noqa: BLE001
+            host_errors[i] = e
+    for i, member in host_members:
+        if i in host_errors:
+            continue
+        try:
+            for key in host_member_keys[i]:
+                if key in build_errors:
+                    raise build_errors[key]
+            agg = _to_f64(member.device_reduce(built, np))
+            prev = host_aggs.get(i)
+            host_aggs[i] = agg if prev is None else member.merge_agg(prev, agg, np)
         except Exception as e:  # noqa: BLE001
             host_errors[i] = e
 
@@ -404,7 +462,11 @@ def _family_hll_mode(batch, column: str):
 
 
 def _precompute_family_kernels(
-    built: Dict[str, np.ndarray], host_assisted, batch=None
+    built: Dict[str, np.ndarray],
+    host_assisted,
+    batch=None,
+    host_members=(),
+    host_errors=(),
 ) -> None:
     """Host-fold scan sharing ACROSS analyzer kinds: when a quantile
     sketch rides the pass, one combined C traversal produces the
@@ -419,8 +481,18 @@ def _precompute_family_kernels(
     from deequ_tpu.analyzers.base import where_key
     from deequ_tpu.ops import native
 
+    # HLL piggybacking is only worth the per-row hash when a host-folded
+    # ApproxCountDistinct on the same (column, where) will consume it
+    acd_families = {
+        (member.column, where_key(getattr(member, "where", None)))
+        for i, member in host_members
+        if getattr(member, "name", "") == "ApproxCountDistinct"
+        and i not in host_errors
+    }
     jobs = []
-    for _, member in host_assisted:
+    for i, member in host_assisted:
+        if i in host_errors:
+            continue  # dead member: don't pay its family kernel
         sample_size = getattr(member, "_sample_size", None)
         column = getattr(member, "column", None)
         if sample_size is None or column is None:
@@ -442,7 +514,10 @@ def _precompute_family_kernels(
                 continue
         except Exception:  # noqa: BLE001 - memo stays unset, members recompute
             continue
-        hll_mode, hashvals = _family_hll_mode(batch, column)
+        if (column, wkey) in acd_families:
+            hll_mode, hashvals = _family_hll_mode(batch, column)
+        else:
+            hll_mode, hashvals = 0, None
         rkey = f"__hllregs:{column}:{wkey}"
         jobs.append((qkey, mkey, rkey, x, valid, warr, cap, hll_mode, hashvals))
 
@@ -537,10 +612,12 @@ class PipelinedAggFold:
         analyzers: Sequence[ScanShareableAnalyzer],
         assisted: Sequence[ScanShareableAnalyzer] = (),
         n_dev: int = 1,
+        sticky=None,
     ):
         self.analyzers = list(analyzers)
         self.assisted = list(assisted)
         self.n_dev = n_dev
+        self.sticky = sticky if sticky is not None else {}
         self._total: Optional[List[Any]] = None
         self._assisted_states: List[Any] = [None] * len(self.assisted)
         self._pending = None
@@ -566,11 +643,14 @@ class PipelinedAggFold:
                 a.merge_agg(t, b, np)
                 for a, t, b in zip(self.analyzers, self._total, batch_aggs)
             ]
+        shifts = wire_shifts(self.sticky)
         for i, (analyzer, out) in enumerate(zip(self.assisted, assisted_out)):
             for d in range(self.n_dev):
                 shard = jax.tree_util.tree_map(
                     lambda x, d=d: np.asarray(x).reshape(self.n_dev, -1)[d], out
                 )
+                if shifts:
+                    shard = analyzer.unshift_batch(shard, shifts)
                 self._assisted_states[i] = analyzer.host_consume(
                     self._assisted_states[i], shard
                 )
@@ -717,7 +797,8 @@ class FusedScanPass:
             )
         )
 
-        fold = PipelinedAggFold(analyzers, assisted)
+        sticky: Dict[str, Any] = {}
+        fold = PipelinedAggFold(analyzers, assisted, sticky=sticky)
         device_spec_keys = sorted(device_keys)
         streaming = bool(getattr(table, "is_streaming", False))
 
@@ -732,7 +813,6 @@ class FusedScanPass:
                 i: [s.key for s in member.input_specs()] for i, member in all_host
             }
         host_assisted_states: Dict[int, Any] = {}
-        sticky: Dict[str, Any] = {}
         for batch in table.batches(self.batch_size):
             # per-key builds with error capture: a failing input (e.g. a
             # predicate over a missing column) fails only the analyzers
@@ -786,6 +866,12 @@ class FusedScanPass:
                 # the final device_get lives here: an execution/transfer
                 # failure surfaces now and must not erase host outcomes
                 aggs, assisted_states = fold.finish()
+                shifts = wire_shifts(sticky)
+                if shifts:
+                    aggs = [
+                        a.unshift_agg(agg, shifts)
+                        for a, agg in zip(analyzers, aggs)
+                    ]
             except Exception as e:  # noqa: BLE001
                 device_error = e
         host_results = materialize_host_results(

@@ -488,19 +488,18 @@ static int sd_core(const double *x, const uint8_t *valid,
     }
 
     /* ---- walk top buckets: resolve constant ones, plan the rest ----- */
-    /* per planned bucket: sub-shift/base; subidx maps bucket -> plan # */
+    /* per planned bucket: its gather area offset (sizes known from P1) */
     int32_t *subidx = (int32_t *)sd_get(18, (size_t)SD_TOP_BUCKETS * 4);
     if (!subidx) return 1;
     memset(subidx, 0xFF, (size_t)SD_TOP_BUCKETS * 4);
     int32_t nplanned = 0;
-    /* plans are bounded by kept <= cap (each owns >= 1 wanted rank) */
     typedef struct {
-        int64_t bucket, rank0, jlo, jhi;
-        int shift;
-        uint64_t base;
+        int64_t rank0, jlo, jhi, gofs, fill;
+        uint64_t kmin, kmax;
     } SdPlan;
     SdPlan *plans = (SdPlan *)sd_get(19, (size_t)kept * sizeof(SdPlan));
     if (!plans) return 1;
+    int64_t gather_total = 0;
     {
         int64_t rank0 = 0;
         for (int64_t b = 0; b < SD_TOP_BUCKETS; b++) {
@@ -519,16 +518,15 @@ static int sd_core(const double *x, const uint8_t *valid,
                     int64_t jhi = jlo;
                     while (jhi < kept && offset + jhi * stride < rank0 + c)
                         jhi++;
-                    int hb = 63 - __builtin_clzll(top[b].mn ^ top[b].mx);
-                    int shift = hb + 1 - SD_SUB_BITS;
-                    if (shift < 0) shift = 0;
                     SdPlan *p = &plans[nplanned];
-                    p->bucket = b;
                     p->rank0 = rank0;
                     p->jlo = jlo;
                     p->jhi = jhi;
-                    p->shift = shift;
-                    p->base = top[b].mn >> shift;
+                    p->gofs = gather_total;
+                    p->fill = gather_total;
+                    p->kmin = top[b].mn;
+                    p->kmax = top[b].mx;
+                    gather_total += c;
                     subidx[b] = nplanned++;
                 }
             }
@@ -551,35 +549,18 @@ static int sd_core(const double *x, const uint8_t *valid,
         return 0;
     }
 
-    /* ---- P2: 256-wide sub-histograms (+min/max: constant detection
-     * at the sub level keeps low-cardinality columns gather-free).
-     * Count/min/max share one 24-byte struct: a sub-bucket update
-     * touches ONE cache line, not three. ------------------------------ */
-    typedef struct {
-        uint64_t mn, mx;
-        uint32_t cnt, pad;
-    } SdSub;
-    SdSub *sub =
-        (SdSub *)sd_get(20, (size_t)nplanned * SD_SUB_W * sizeof(SdSub));
-    if (!sub) return 1;
-    for (int64_t s = 0; s < (int64_t)nplanned * SD_SUB_W; s++) {
-        sub[s].mn = ~0ULL;
-        sub[s].mx = 0ULL;
-        sub[s].cnt = 0;
-    }
+    /* ---- P2: gather planned buckets' keys whole (sizes known from
+     * P1), m2 riding the same pass; each plan's contiguous segment is
+     * then resolved by the recursive radix select, whose histograms run
+     * over the (cache-friendly) gathered data instead of a third full
+     * scan of x ------------------------------------------------------ */
+    uint64_t *scratch = (uint64_t *)sd_get(0, (size_t)gather_total * 8);
+    if (!scratch) return 1;
     for (int64_t i = 0; i < n; i++) {
         if (sd_masked_out(valid, where, i)) continue;
         uint64_t k = f64_key(x[i]);
         int32_t si = subidx[k >> SD_TOP_SHIFT];
-        if (si >= 0) {
-            SdPlan *p = &plans[si];
-            SdSub *s =
-                &sub[((int64_t)si << SD_SUB_BITS) +
-                     (int64_t)((k >> p->shift) - p->base)];
-            s->cnt++;
-            if (k < s->mn) s->mn = k;
-            if (k > s->mx) s->mx = k;
-        }
+        if (si >= 0) scratch[plans[si].fill++] = k;
         if (mom) {
             double d = x[i] - avg;
             m2acc += d * d;
@@ -587,78 +568,12 @@ static int sd_core(const double *x, const uint8_t *valid,
     }
     if (mom) mom[4] = (double)m2acc;
 
-    /* ---- walk sub-buckets: mark the ones owning wanted ranks -------- */
-    /* gather offsets per (plan, sub-bucket); wanted segments <= kept */
-    int32_t *gstart = (int32_t *)sd_get(21, (size_t)nplanned * SD_SUB_W * 4);
-    if (!gstart) return 1;
-    memset(gstart, 0xFF, (size_t)nplanned * SD_SUB_W * 4);
-    typedef struct {
-        int64_t gofs, count, rank0, jlo, jhi;
-        uint64_t kmin, kmax;
-    } SdSeg;
-    SdSeg *segs = (SdSeg *)sd_get(22, (size_t)kept * sizeof(SdSeg));
-    if (!segs) return 1;
-    int32_t nsegs = 0;
-    int64_t gather_total = 0;
-    for (int32_t si = 0; si < nplanned; si++) {
-        SdPlan *p = &plans[si];
-        int64_t rank0 = p->rank0;
-        int64_t j = p->jlo;
-        for (int64_t sb = 0; sb < SD_SUB_W && j < p->jhi; sb++) {
-            int64_t slot = ((int64_t)si << SD_SUB_BITS) + sb;
-            int64_t c = (int64_t)sub[slot].cnt;
-            if (c == 0) continue;
-            if (offset + j * stride < rank0 + c) {
-                int64_t jhi = j;
-                while (jhi < p->jhi && offset + jhi * stride < rank0 + c)
-                    jhi++;
-                if (sub[slot].mn == sub[slot].mx) {
-                    double v = key_f64(sub[slot].mn);
-                    for (int64_t jj = j; jj < jhi; jj++) samples[jj] = v;
-                } else {
-                    gstart[slot] = (int32_t)nsegs;
-                    SdSeg *s = &segs[nsegs++];
-                    s->gofs = gather_total;
-                    s->count = c;
-                    s->rank0 = rank0;
-                    s->jlo = j;
-                    s->jhi = jhi;
-                    s->kmin = sub[slot].mn;
-                    s->kmax = sub[slot].mx;
-                    gather_total += c;
-                }
-                j = jhi;
-            }
-            rank0 += c;
-        }
-    }
-
-    if (nsegs == 0) return 0; /* all wanted sub-buckets were constant */
-
-    /* ---- P3: gather wanted sub-buckets ------------------------------ */
-    uint64_t *scratch = (uint64_t *)sd_get(0, (size_t)gather_total * 8);
-    int64_t *gfill = (int64_t *)sd_get(23, (size_t)nsegs * 8);
-    if (!scratch || !gfill) return 1;
-    for (int32_t s = 0; s < nsegs; s++) gfill[s] = segs[s].gofs;
-    for (int64_t i = 0; i < n; i++) {
-        if (sd_masked_out(valid, where, i)) continue;
-        uint64_t k = f64_key(x[i]);
-        int32_t si = subidx[k >> SD_TOP_SHIFT];
-        if (si >= 0) {
-            SdPlan *p = &plans[si];
-            int32_t g =
-                gstart[((int64_t)si << SD_SUB_BITS) +
-                       (int64_t)((k >> p->shift) - p->base)];
-            if (g >= 0) scratch[gfill[g]++] = k;
-        }
-    }
-
-    /* ---- resolve each gathered segment ------------------------------ */
-    for (int32_t s = 0; s < nsegs; s++) {
-        SdSeg *sg = &segs[s];
-        int rc = resolve_segment(scratch + sg->gofs, sg->count, sg->kmin,
-                                 sg->kmax, offset - sg->rank0, stride,
-                                 sg->jlo, sg->jhi, samples, 1);
+    /* ---- resolve each plan's gathered segment ----------------------- */
+    for (int32_t s = 0; s < nplanned; s++) {
+        SdPlan *sg = &plans[s];
+        int rc = resolve_segment(scratch + sg->gofs, sg->fill - sg->gofs,
+                                 sg->kmin, sg->kmax, offset - sg->rank0,
+                                 stride, sg->jlo, sg->jhi, samples, 1);
         if (rc) return rc;
     }
     return 0;

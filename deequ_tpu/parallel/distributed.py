@@ -203,7 +203,9 @@ class DistributedScanPass:
         sticky: Dict[str, Any] = {}
         streaming = bool(getattr(table, "is_streaming", False))
         try:
-            fold = PipelinedAggFold(merge_analyzers, assisted, n_dev=n_devices)
+            fold = PipelinedAggFold(
+                merge_analyzers, assisted, n_dev=n_devices, sticky=sticky
+            )
 
             device_error: Any = None
             for batch in table.batches(global_batch):
@@ -240,6 +242,24 @@ class DistributedScanPass:
                                     arr, key, sticky
                                 )
                             elif arr.dtype != np.bool_:
+                                if (
+                                    np.dtype(dtype) == np.float32
+                                    and key.startswith("num:")
+                                ):
+                                    # same f32 pre-centering as
+                                    # pack_batch_inputs (see fused.py)
+                                    from deequ_tpu.ops.fused import (
+                                        resolve_shift,
+                                    )
+
+                                    shift = resolve_shift(
+                                        key, arr, sticky, built.get
+                                    )
+                                    if shift != 0.0:
+                                        arr = (
+                                            np.asarray(arr, dtype=np.float64)
+                                            - shift
+                                        )
                                 arr = arr.astype(dtype)
                             inputs[key] = jax.device_put(arr, in_sharding[key])
                         runtime.record_launch()
@@ -256,6 +276,14 @@ class DistributedScanPass:
             if device_error is None:
                 try:
                     aggs, assisted_states = fold.finish()
+                    from deequ_tpu.ops.fused import wire_shifts
+
+                    shifts = wire_shifts(sticky)
+                    if shifts:
+                        aggs = [
+                            a.unshift_agg(agg, shifts)
+                            for a, agg in zip(merge_analyzers, aggs)
+                        ]
                 except Exception as e:  # noqa: BLE001
                     device_error = e
             if device_error is not None:

@@ -175,15 +175,22 @@ class _LowCardCounts(ScanShareableAnalyzer):
                 "counts": counts,
                 "uniques": np.asarray([False, True], dtype=object),
             }
-        if len(uniques) > self.cap:
-            # this batch alone blows the cap: no histogram will be kept
-            # for the column, so skip the counting work entirely
+        aborted = len(uniques) > self.cap
+        if aborted and len(uniques) > (1 << 16):
+            # dictionary too large even for the presence side-product
             return {"aborted": True}
         counts = native.bincount(codes, len(uniques) + 1, base=1)
         if counts is None:
             counts = np.bincount(
                 codes + 1, minlength=len(uniques) + 1
             ).astype(np.int64)
+        # side-product for ApproxCountDistinct on this string column:
+        # which dictionary entries actually occur (nulls excluded) —
+        # registers over PRESENT uniques replace its full-row scatter
+        inputs[f"__lccpresence:{self.column}"] = (counts[1:] > 0, uniques)
+        if aborted:
+            # cap blown: no histogram for this column, skip dict building
+            return {"aborted": True}
         return {"counts": counts, "uniques": uniques}
 
     def host_consume(self, state: Optional[State], out: Any) -> Optional[State]:

@@ -6,9 +6,9 @@ reference: repository/fs/FileSystemMetricsRepository.scala:32-226.
 
 from __future__ import annotations
 
-import os
-import tempfile
 from typing import List, Optional
+
+from deequ_tpu.core.fsio import FileSystem, resolve_filesystem
 
 from deequ_tpu.repository.base import (
     AnalysisResult,
@@ -24,8 +24,14 @@ from deequ_tpu.runners.context import AnalyzerContext
 
 
 class FileSystemMetricsRepository(MetricsRepository):
-    def __init__(self, path: str):
+    """`filesystem` selects the storage backend (core/fsio.py): local
+    disk by default, MemoryFileSystem for object-store-style semantics,
+    FsspecFileSystem for real object stores — the role of the
+    reference's Hadoop FileSystem qualification (DfsUtils.scala:24-84)."""
+
+    def __init__(self, path: str, filesystem: FileSystem = None):
         self.path = path
+        self.filesystem = resolve_filesystem(filesystem)
 
     def save(self, result_key: ResultKey, analyzer_context: AnalyzerContext) -> None:
         successful = AnalyzerContext(
@@ -52,27 +58,17 @@ class FileSystemMetricsRepository(MetricsRepository):
     # -- internals -----------------------------------------------------------
 
     def _load_all(self) -> List[AnalysisResult]:
-        if not os.path.exists(self.path):
+        if not self.filesystem.exists(self.path):
             return []
-        with open(self.path, "r", encoding="utf-8") as f:
-            payload = f.read()
+        payload = self.filesystem.read_bytes(self.path).decode("utf-8")
         if not payload.strip():
             return []
         return deserialize_analysis_results(payload)
 
     def _write_atomically(self, payload: str) -> None:
-        """tmp file + rename (reference: FileSystemMetricsRepository.scala:167-195)."""
-        directory = os.path.dirname(os.path.abspath(self.path)) or "."
-        os.makedirs(directory, exist_ok=True)
-        fd, tmp_path = tempfile.mkstemp(dir=directory, suffix=".tmp")
-        try:
-            with os.fdopen(fd, "w", encoding="utf-8") as f:
-                f.write(payload)
-            os.replace(tmp_path, self.path)
-        except BaseException:
-            if os.path.exists(tmp_path):
-                os.unlink(tmp_path)
-            raise
+        """Atomic publish through the fs seam (local: tmp + rename —
+        reference: FileSystemMetricsRepository.scala:167-195)."""
+        self.filesystem.write_bytes(self.path, payload.encode("utf-8"))
 
 
 class FileSystemMetricsRepositoryMultipleResultsLoader(
