@@ -20,8 +20,8 @@ Knobs (env):
     BENCH_MODE      "profiler" | "scan" | "stream"  (default "profiler")
                     stream = full profile over an on-disk Parquet file via
                     Table.scan_parquet (out-of-core; constant host memory)
-    BENCH_TIMED     timed repetitions, best-of (default 4: shared-vCPU
-                     boxes show 20-30% run-to-run noise; best-of-4 reads
+    BENCH_TIMED     timed repetitions, best-of (default 5: shared-vCPU
+                     boxes show 20-30% run-to-run noise; best-of-5 reads
                      the machine's actual capability. Compile happens
                      during the warmup run)
     BENCH_PARQUET   path for the stream-mode file (default /tmp/bench.parquet;
@@ -343,7 +343,7 @@ def main() -> None:
         jax.config.update("jax_platforms", platform)
     n_rows = int(os.environ.get("BENCH_ROWS", "10000000"))
     mode = os.environ.get("BENCH_MODE", "profiler")
-    reps = max(1, int(os.environ.get("BENCH_TIMED", "4")))
+    reps = max(1, int(os.environ.get("BENCH_TIMED", "5")))
 
     t_gen = time.perf_counter()
     if mode == "stream":

@@ -229,6 +229,17 @@ class Completeness(_RatioAnalyzer):
                     "count": mom["n_where"],
                     "guard": mom["n_rows"],
                 }
+            if self.where is None:
+                # string/bool column counted by _LowCardCounts this
+                # batch: null count is already known
+                nulls = inputs.get(f"__lccnulls:{self.column}")
+                if nulls is not None:
+                    null_count, n = nulls
+                    return {
+                        "matches": float(n - null_count),
+                        "count": float(n),
+                        "guard": float(n),
+                    }
         return super().device_reduce(inputs, xp)
 
     def __repr__(self) -> str:

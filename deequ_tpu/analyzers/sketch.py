@@ -65,6 +65,25 @@ class ApproxCountDistinctState(DoubleValuedState):
         return hash(self.registers.tobytes())
 
 
+_BOOL_HLL = None
+
+
+def _bool_hll_identities():
+    """(idx, rank, packed) for the two canonical boolean identities
+    (int64 0/1) — ONE definition shared by the per-row gather spec and
+    the _LowCardCounts presence shortcut, computed once."""
+    global _BOOL_HLL
+    if _BOOL_HLL is None:
+        from deequ_tpu.ops.sketches.hll import xxhash64_u64
+
+        idx, rank = hll.registers_from_hashes(
+            xxhash64_u64(np.array([0, 1], dtype=np.int64))
+        )
+        packed = ((idx << 6) | rank).astype(np.int32)
+        _BOOL_HLL = (idx, rank, packed)
+    return _BOOL_HLL
+
+
 def _hll_spec(column: str) -> InputSpec:
     """One int32 per row packing (register idx << 6 | rank) so the column
     is hashed exactly once per batch; invalid rows pack to 0 (idx 0,
@@ -88,12 +107,7 @@ def _hll_spec(column: str) -> InputSpec:
         if col.ctype == ColumnType.BOOLEAN:
             # two possible identities (canonical int64 0/1): hash them
             # once and gather — no per-row hashing
-            from deequ_tpu.ops.sketches.hll import xxhash64_u64
-
-            idx_u, rank_u = hll.registers_from_hashes(
-                xxhash64_u64(np.array([0, 1], dtype=np.int64))
-            )
-            packed_u = ((idx_u << 6) | rank_u).astype(np.int32)
+            _idx, _rank, packed_u = _bool_hll_identities()
             return np.where(
                 col.valid, packed_u[col.values.view(np.uint8)], np.int32(0)
             )
@@ -140,6 +154,18 @@ class ApproxCountDistinct(ScanShareableAnalyzer):
             if regs is not None:
                 return {"registers": np.asarray(regs)}
             if self.where is None:
+                # a bool column counted this batch (_LowCardCounts):
+                # registers from the ≤2 present canonical identities
+                pres_bool = inputs.get(f"__lccbool:{self.column}")
+                if pres_bool is not None:
+                    idx, rank, _packed = _bool_hll_identities()
+                    registers = np.zeros(hll.M, dtype=np.int32)
+                    for value, present in enumerate(pres_bool):
+                        if present:
+                            registers[idx[value]] = max(
+                                registers[idx[value]], int(rank[value])
+                            )
+                    return {"registers": registers}
                 # a string column whose dictionary presence was counted
                 # this batch (_LowCardCounts): hash only the PRESENT
                 # uniques — identical registers, no full-row scatter

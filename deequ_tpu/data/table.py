@@ -347,8 +347,22 @@ class Table:
             elif np.issubdtype(arr.dtype, np.datetime64):
                 ctype = ColumnType.TIMESTAMP
             else:
-                ctype = ColumnType.STRING
-                arr = arr.astype(object)
+                # object arrays go through the same inference as
+                # from_pydict: {bool, None} is a BOOLEAN column (its
+                # histogram keys must be 'true'/'false', not Python's
+                # str(True)), object ints are LONG, etc. A caller-supplied
+                # mask ANDs with the non-null mask the values imply.
+                inferred = _column_from_list(name, list(arr), None)
+                extra_mask = valid.get(name)
+                if extra_mask is not None:
+                    inferred = Column(
+                        name,
+                        inferred.ctype,
+                        inferred.values,
+                        inferred.valid & np.asarray(extra_mask, dtype=np.bool_),
+                    )
+                cols.append(inferred)
+                continue
             v = valid.get(name)
             if v is None:
                 if ctype in (ColumnType.DOUBLE, ColumnType.DECIMAL):

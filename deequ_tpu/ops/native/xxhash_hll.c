@@ -408,7 +408,13 @@ static int sd_core(const double *x, const uint8_t *valid,
 
     int64_t m = 0, n_where = 0;
     uint64_t kmin = ~0ULL, kmax = 0ULL;
+    /* block accumulation: the inner 2048-element partial runs in SSE
+     * doubles (an x87 long-double add per row serializes the loop); the
+     * outer fold stays long double, so total error ~ pairwise-summation
+     * class, comfortably inside the 1e-12 parity tests */
     long double sum = 0.0L;
+    double bsum = 0.0;
+    int bn = 0;
     for (int64_t i = 0; i < n; i++) {
         if (where && !where[i]) continue;
         n_where++;
@@ -421,7 +427,14 @@ static int sd_core(const double *x, const uint8_t *valid,
         if (k > t->mx) t->mx = k;
         if (k < kmin) kmin = k;
         if (k > kmax) kmax = k;
-        if (mom) sum += x[i];
+        if (mom) {
+            bsum += x[i];
+            if (++bn == 2048) {
+                sum += bsum;
+                bsum = 0.0;
+                bn = 0;
+            }
+        }
         if (hll_mode) {
             uint64_t canon;
             if (hll_mode == 1) {
@@ -438,6 +451,7 @@ static int sd_core(const double *x, const uint8_t *valid,
         }
     }
     if (mom) {
+        sum += bsum;
         mom[0] = (double)m;
         mom[1] = (double)sum;
         mom[2] = m > 0 ? key_f64(kmin) : (double)INFINITY;
@@ -535,6 +549,8 @@ static int sd_core(const double *x, const uint8_t *valid,
     }
 
     long double m2acc = 0.0L;
+    double bm2 = 0.0;
+    int bm2n = 0;
     double avg = mom && m > 0 ? mom[1] / (double)m : 0.0;
     if (nplanned == 0) {
         /* every wanted bucket was constant; m2 still needs a pass */
@@ -542,8 +558,14 @@ static int sd_core(const double *x, const uint8_t *valid,
             for (int64_t i = 0; i < n; i++) {
                 if (sd_masked_out(valid, where, i)) continue;
                 double d = x[i] - avg;
-                m2acc += d * d;
+                bm2 += d * d;
+                if (++bm2n == 2048) {
+                    m2acc += bm2;
+                    bm2 = 0.0;
+                    bm2n = 0;
+                }
             }
+            m2acc += bm2;
             mom[4] = (double)m2acc;
         }
         return 0;
@@ -563,10 +585,18 @@ static int sd_core(const double *x, const uint8_t *valid,
         if (si >= 0) scratch[plans[si].fill++] = k;
         if (mom) {
             double d = x[i] - avg;
-            m2acc += d * d;
+            bm2 += d * d;
+            if (++bm2n == 2048) {
+                m2acc += bm2;
+                bm2 = 0.0;
+                bm2n = 0;
+            }
         }
     }
-    if (mom) mom[4] = (double)m2acc;
+    if (mom) {
+        m2acc += bm2;
+        mom[4] = (double)m2acc;
+    }
 
     /* ---- resolve each plan's gathered segment ----------------------- */
     for (int32_t s = 0; s < nplanned; s++) {

@@ -171,6 +171,16 @@ class _LowCardCounts(ScanShareableAnalyzer):
                 [len(codes) - n_valid, n_valid - n_true, n_true],
                 dtype=np.int64,
             )
+            # side-products: ApproxCountDistinct builds registers from
+            # the ≤2 present identities; Completeness reads the counts
+            inputs[f"__lccbool:{self.column}"] = (
+                n_valid - n_true > 0,
+                n_true > 0,
+            )
+            inputs[f"__lccnulls:{self.column}"] = (
+                int(counts[0]),
+                len(codes),
+            )
             return {
                 "counts": counts,
                 "uniques": np.asarray([False, True], dtype=object),
@@ -184,10 +194,12 @@ class _LowCardCounts(ScanShareableAnalyzer):
             counts = np.bincount(
                 codes + 1, minlength=len(uniques) + 1
             ).astype(np.int64)
-        # side-product for ApproxCountDistinct on this string column:
-        # which dictionary entries actually occur (nulls excluded) —
-        # registers over PRESENT uniques replace its full-row scatter
+        # side-products for this string column: which dictionary entries
+        # actually occur (ApproxCountDistinct builds registers over the
+        # PRESENT uniques instead of a full-row scatter) and the null
+        # count (Completeness answers without a popcount)
         inputs[f"__lccpresence:{self.column}"] = (counts[1:] > 0, uniques)
+        inputs[f"__lccnulls:{self.column}"] = (int(counts[0]), len(codes))
         if aborted:
             # cap blown: no histogram for this column, skip dict building
             return {"aborted": True}

@@ -184,3 +184,24 @@ class TestPredicate:
     def test_not(self):
         m = eval_predicate("NOT att2 = 0", self.table())
         assert list(m) == [False, False, False, True, True, True]
+
+
+def test_from_numpy_object_bool_column_is_boolean():
+    """An object array of {bool, None} must infer BOOLEAN (like
+    from_pydict) so histogram keys render as the reference's
+    'true'/'false', not Python's str(True) (found by a verify drive,
+    round 4)."""
+    import numpy as np
+
+    from deequ_tpu.data.table import ColumnType, Table
+
+    rng = np.random.default_rng(3)
+    flag = np.where(rng.random(200) > 0.2, rng.random(200) < 0.5, None)
+    t = Table.from_numpy({"flag": flag})
+    col = t.column("flag")
+    assert col.ctype == ColumnType.BOOLEAN
+    assert col.valid.sum() == sum(v is not None for v in flag)
+    from deequ_tpu.profiles.column_profiler import ColumnProfiler
+
+    hist = ColumnProfiler.profile(t).profiles["flag"].histogram
+    assert set(hist.values) <= {"true", "false", "NullValue"}
