@@ -390,3 +390,73 @@ class TestLowCardCountsCap:
             Table.scan_parquet(path, batch_rows=1000)
         ).run()
         assert profiles.profiles["s"].histogram is None  # 1200 distinct > 120
+
+
+class TestOptimisticPass2Fallback:
+    def test_regex_numeric_but_uncastable_falls_back_to_pass2(self):
+        """THE soundness edge: '+ 5' matches the Integral regex
+        (reference: StatefulDataType.scala:37 allows one space after the
+        sign) but float() cannot parse it. Inference says Integral, the
+        optimistic state dies, and the profiler must pay a real pass 2
+        whose cast nulls the unparseable value — same as the reference's
+        cast semantics."""
+        data = Table.from_pydict(
+            {"v": ["+ 5", "3", "7", None] * 30}
+        )
+        with runtime.monitored() as stats:
+            profiles = ColumnProfilerRunner.on_data(data).run()
+        p = profiles.profiles["v"]
+        assert p.data_type == "Integral"  # regex-based inference
+        # cast: '+ 5' -> null; mean over {3,7}
+        assert p.mean == pytest.approx(5.0)
+        assert stats.jobs == 2  # optimistic died -> classic pass 2 ran
+
+    def test_differential_profile_vs_pandas(self):
+        """Randomized differential: the one-pass profile must match a
+        straightforward pandas ground truth on exact statistics for
+        mixed schemas with nulls, numeric strings, empty strings and
+        unicode."""
+        import pandas as pd
+
+        rng = np.random.default_rng(123)
+        for trial in range(5):
+            n = int(rng.integers(200, 3000))
+            num = rng.normal(10, 3, n)
+            num[rng.random(n) < 0.1] = np.nan
+            codes = np.array(
+                [str(v) for v in rng.integers(-50, 50, n)], dtype=object
+            )
+            cats = np.array(
+                ["α", "beta", "", "Ωmega", None], dtype=object
+            )[rng.integers(0, 5, n)]
+            flags = np.where(rng.random(n) > 0.2, rng.random(n) < 0.5, None)
+            t = Table.from_numpy(
+                {"num": num, "code": codes, "cat": cats, "flag": flags}
+            )
+            profiles = ColumnProfilerRunner.on_data(t).run()
+
+            s = pd.Series(num)
+            p = profiles.profiles["num"]
+            assert p.completeness == pytest.approx(s.notna().mean())
+            assert p.mean == pytest.approx(s.mean(), rel=1e-9)
+            assert p.minimum == s.min() and p.maximum == s.max()
+            assert p.std_dev == pytest.approx(s.std(ddof=0), rel=1e-9)
+
+            pc = profiles.profiles["code"]
+            cast = pd.to_numeric(pd.Series(codes), errors="coerce")
+            assert pc.data_type == "Integral"
+            assert pc.mean == pytest.approx(cast.mean(), rel=1e-9)
+            assert pc.sum == pytest.approx(cast.sum(), rel=1e-9)
+
+            pcat = profiles.profiles["cat"]
+            counts = pd.Series(cats).value_counts(dropna=False)
+            hist = {k: v.absolute for k, v in pcat.histogram.values.items()}
+            want = {
+                ("NullValue" if pd.isna(k) else str(k)): int(c)
+                for k, c in counts.items()
+            }
+            assert hist == want, (trial, hist, want)
+
+            pf = profiles.profiles["flag"]
+            fs = pd.Series(list(flags))
+            assert pf.completeness == pytest.approx(fs.notna().mean())
