@@ -813,7 +813,21 @@ class FusedScanPass:
                 i: [s.key for s in member.input_specs()] for i, member in all_host
             }
         host_assisted_states: Dict[int, Any] = {}
-        for batch in table.batches(self.batch_size):
+        batch_size = self.batch_size
+        if (
+            not use_device
+            and not streaming
+            and batch_size == DEFAULT_BATCH_SIZE
+        ):
+            # pure host fold over an in-memory table at the DEFAULT batch
+            # size (an explicitly configured size is respected — callers
+            # may be bounding peak memory): the 4M cap exists for the f32
+            # DEVICE wire (2^24 count exactness) and for stream memory
+            # bounds — neither applies, and one batch saves the per-batch
+            # machinery and sketch folds. Capped at ~16M rows so
+            # worst-case kernel scratch stays bounded.
+            batch_size = max(batch_size, min(table.num_rows, 1 << 24))
+        for batch in table.batches(batch_size):
             # per-key builds with error capture: a failing input (e.g. a
             # predicate over a missing column) fails only the analyzers
             # that need it — host members individually, the device group
