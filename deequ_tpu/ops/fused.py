@@ -670,10 +670,16 @@ class FusedScanPass:
     def __init__(
         self,
         analyzers: Sequence[ScanShareableAnalyzer],
-        batch_size: int = DEFAULT_BATCH_SIZE,
+        batch_size: Optional[int] = None,
     ):
         self.analyzers = list(analyzers)
-        self.batch_size = batch_size
+        # None = unset: the pass may widen the default for pure-host
+        # in-memory folds; an EXPLICIT size (even one equal to the
+        # default) is always honored as a memory bound
+        self._batch_size_explicit = batch_size is not None
+        self.batch_size = (
+            batch_size if batch_size is not None else DEFAULT_BATCH_SIZE
+        )
 
     def run(self, table: Table) -> List[AnalyzerRunResult]:
         # 1. collect input specs; an analyzer whose spec construction fails
@@ -817,13 +823,13 @@ class FusedScanPass:
         if (
             not use_device
             and not streaming
-            and batch_size == DEFAULT_BATCH_SIZE
+            and not self._batch_size_explicit
         ):
-            # pure host fold over an in-memory table at the DEFAULT batch
-            # size (an explicitly configured size is respected — callers
-            # may be bounding peak memory): the 4M cap exists for the f32
-            # DEVICE wire (2^24 count exactness) and for stream memory
-            # bounds — neither applies, and one batch saves the per-batch
+            # pure host fold over an in-memory table with no explicit
+            # batch size (explicit sizes are memory bounds and always
+            # honored): the 4M default exists for the f32 DEVICE wire
+            # (2^24 count exactness) and for stream memory bounds —
+            # neither applies, and one batch saves the per-batch
             # machinery and sketch folds. Capped at ~16M rows so
             # worst-case kernel scratch stays bounded.
             batch_size = max(batch_size, min(table.num_rows, 1 << 24))

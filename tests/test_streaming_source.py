@@ -240,3 +240,70 @@ class TestStreamingParity:
         )
         assert got2 == pytest.approx(expected, rel=1e-12)
         assert "cat" not in declared.with_columns(["x"]).base.column_names
+
+    def test_timestamp_and_decimal_parity(self, tmp_path):
+        """Timestamp and decimal columns behave identically in-memory
+        and streamed through the (round-4) zero-copy materialization:
+        decimals compute numerics, Min/Max on timestamps raise the
+        reference's WrongColumnTypeException (isNumeric precondition),
+        completeness counts nulls exactly."""
+        import decimal
+
+        from deequ_tpu.analyzers import Minimum
+        from deequ_tpu.core.exceptions import WrongColumnTypeException
+
+        rng = np.random.default_rng(5)
+        n = 20_000
+        ts = pa.array(
+            [
+                None
+                if i % 17 == 0
+                else v
+                for i, v in enumerate(
+                    (
+                        rng.integers(1_500_000_000, 1_700_000_000, n)
+                        * 1_000_000
+                    ).astype("datetime64[us]")
+                )
+            ]
+        )
+        dec = pa.array(
+            [
+                None
+                if i % 13 == 0
+                else decimal.Decimal(
+                    f"{rng.integers(0, 10000)}.{rng.integers(0, 100):02d}"
+                )
+                for i in range(n)
+            ],
+            type=pa.decimal128(12, 2),
+        )
+        path = str(tmp_path / "tsdec.parquet")
+        pq.write_table(pa.table({"ts": ts, "dec": dec}), path, row_group_size=4096)
+
+        analyzers = [
+            Completeness("ts"),
+            Completeness("dec"),
+            Mean("dec"),
+            Minimum("dec"),
+            Maximum("dec"),
+            Minimum("ts"),
+        ]  # Mean/Maximum come from the module-level import
+        results = {}
+        for label, tab in (
+            ("mem", Table.from_parquet(path)),
+            ("stream", Table.scan_parquet(path)),
+        ):
+            ctx = AnalysisRunner.on_data(tab).add_analyzers(analyzers).run()
+            results[label] = ctx.metric_map
+        for analyzer in analyzers:
+            m, s = results["mem"][analyzer], results["stream"][analyzer]
+            assert m.value.is_success == s.value.is_success, repr(analyzer)
+            if m.value.is_success:
+                assert m.value.get() == pytest.approx(s.value.get(), rel=1e-12)
+        assert results["mem"][Completeness("ts")].value.get() == pytest.approx(
+            sum(1 for i in range(n) if i % 17 != 0) / n
+        )
+        failure = results["mem"][Minimum("ts")].value
+        assert not failure.is_success
+        assert isinstance(failure.exception, WrongColumnTypeException)
