@@ -218,31 +218,55 @@ class ParquetSource(DataSource):
             n for n, t in self._schema_cache if t == ColumnType.STRING
         ]
         with pq.ParquetFile(
-            self.path, read_dictionary=str_cols or None
+            self.path,
+            read_dictionary=str_cols or None,
+            memory_map=True,  # page-cache-warm reads skip a buffer copy
         ) as pf:
+            # One batch per row group (sliced down when a group exceeds
+            # the cap). TINY groups (< size/4 — incremental writers often
+            # produce 10k-row groups) still coalesce, or per-batch fold
+            # machinery would multiply 100x; near-batch-size groups pass
+            # through directly because pa.concat_tables forces a
+            # dictionary unification on string columns that costs more
+            # (~0.9s/100M measured) than the machinery it saves.
             import pyarrow as pa
 
-            # coalesce consecutive row groups up to the batch size: files
-            # written with small groups (pyarrow defaults to 1M rows)
-            # would otherwise fix the batch at group size, multiplying
-            # the per-batch costs of the fold (~25ms of host machinery
-            # per batch, measured) by 4x. Memory stays bounded by `size`.
+            tiny = max(1, size // 4)
             pending: list = []
             pending_rows = 0
+
+            def flush():
+                if not pending:
+                    return None
+                merged = (
+                    pending[0]
+                    if len(pending) == 1
+                    else pa.concat_tables(pending)
+                )
+                pending.clear()
+                return merged
+
             for g in range(pf.metadata.num_row_groups):
                 group = pf.read_row_group(g, columns=self.columns)
-                pending.append(group)
-                pending_rows += group.num_rows
-                if pending_rows < size and g + 1 < pf.metadata.num_row_groups:
-                    continue
-                merged = (
-                    pending[0] if len(pending) == 1 else pa.concat_tables(pending)
-                )
-                pending = []
-                pending_rows = 0
-                for start in range(0, merged.num_rows, size):
-                    yield Table.from_arrow(merged.slice(start, size))
-                del merged, group
+                if group.num_rows < tiny:
+                    pending.append(group)
+                    pending_rows += group.num_rows
+                    if pending_rows < size:
+                        continue
+                    group = flush()
+                    pending_rows = 0
+                elif pending:
+                    head = flush()
+                    pending_rows = 0
+                    for start in range(0, head.num_rows, size):
+                        yield Table.from_arrow(head.slice(start, size))
+                for start in range(0, group.num_rows, size):
+                    yield Table.from_arrow(group.slice(start, size))
+                del group
+            tail = flush()
+            if tail is not None:
+                for start in range(0, tail.num_rows, size):
+                    yield Table.from_arrow(tail.slice(start, size))
 
     def __repr__(self) -> str:
         return f"ParquetSource({self.path!r}, rows={self._num_rows})"

@@ -157,7 +157,10 @@ class Column:
         return self.numeric_values()[0]
 
     def dict_encode(self) -> Tuple[np.ndarray, np.ndarray]:
-        """Dictionary-encode: (codes int64, uniques). Null rows get code -1.
+        """Dictionary-encode: (codes, uniques). Null rows get code -1.
+        Codes are an integer array — int64 from the numpy/arrow encode
+        paths, int32 when a parquet dictionary column's indices map
+        zero-copy; consumers must not assume an 8-byte stride.
 
         The group-by building block: arbitrary keys become dense integer
         codes the device can bincount/segment-reduce over. Memoized per
@@ -498,11 +501,15 @@ class Table:
                 # result — no per-row string materialization, no re-encode.
                 # `values` stays lazy; only consumers that truly need
                 # per-row python strings pay the gather.
-                codes = (
-                    arr.indices.fill_null(-1)
-                    .to_numpy(zero_copy_only=False)
-                    .astype(np.int64)
-                )
+                # int32 stays int32: arrow dictionary indices feed
+                # bincount/gathers directly (the int64 upcast cost a
+                # copy plus double the bincount traffic); null-free
+                # indices map zero-copy
+                idx = arr.indices
+                if idx.null_count == 0:
+                    codes = idx.to_numpy(zero_copy_only=True)
+                else:
+                    codes = idx.fill_null(-1).to_numpy(zero_copy_only=False)
                 uniques = arr.dictionary.to_numpy(zero_copy_only=False)
                 if uniques.dtype != object:
                     uniques = uniques.astype(object)

@@ -130,7 +130,7 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_double),
         ]
         lib.masked_moments.restype = None
-        for name in ("bincount_i64", "bincount_i8"):
+        for name in ("bincount_i64", "bincount_i32", "bincount_i8"):
             fn = getattr(lib, name)
             fn.argtypes = [
                 ctypes.c_void_p,
@@ -242,14 +242,16 @@ def bincount(
     where: Optional[np.ndarray] = None,
 ) -> Optional[np.ndarray]:
     """counts[c + base] over in-range codes in one pass (no shifted-copy
-    temp); None when native is unavailable. Accepts int8/int64 codes
-    (other int dtypes are converted)."""
+    temp); None when native is unavailable. Accepts int8/int32/int64
+    codes natively (other int dtypes are converted to int64)."""
     lib = _load()
     if lib is None:
         return None
     codes = np.ascontiguousarray(codes)
     if codes.dtype == np.int8:
         fn = lib.bincount_i8
+    elif codes.dtype == np.int32:
+        fn = lib.bincount_i32
     else:
         if codes.dtype != np.int64:
             codes = codes.astype(np.int64)
