@@ -328,9 +328,24 @@ def pallas_onchip_check() -> str:
         host = np.zeros(hll.M, dtype=np.int32)
         packed_np = np.asarray(packed)
         np.maximum.at(host, packed_np >> 6, packed_np & 0x3F)
-        if np.array_equal(on_chip, xla) and np.array_equal(on_chip, host):
-            return "ok"
-        return "MISMATCH"
+        if not (np.array_equal(on_chip, xla) and np.array_equal(on_chip, host)):
+            return "MISMATCH:hll"
+        # the MXU hist16 radix-select kernel, also on silicon: counts
+        # must match a host bincount of the same sortable-key bins
+        x32 = rng.lognormal(0.0, 2.0, n).astype(np.float32)
+        live = rng.random(n) > 0.1
+        bins = jax.jit(pallas_kernels.f32_sortable_bin16)(
+            jnp.asarray(x32), jnp.asarray(live)
+        )
+        hist_chip = np.asarray(jax.jit(pallas_kernels.hist16)(bins)).reshape(
+            65536
+        )
+        host_hist = np.bincount(
+            np.asarray(bins).astype(np.int64) & 0xFFFF, minlength=65536
+        )
+        if not np.array_equal(hist_chip.astype(np.int64), host_hist):
+            return "MISMATCH:hist16"
+        return "ok"
     except Exception as e:  # noqa: BLE001 - report, never break the bench
         return f"skipped:{type(e).__name__}"
 

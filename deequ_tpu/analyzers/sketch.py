@@ -65,6 +65,14 @@ class ApproxCountDistinctState(DoubleValuedState):
         return hash(self.registers.tobytes())
 
 
+def _hist16_available(n: int) -> bool:
+    """Pallas hist16 usable for this batch shape (TPU platform + block
+    multiple); interpret-mode tests monkeypatch this."""
+    from deequ_tpu.ops import pallas_kernels
+
+    return pallas_kernels.shape_supported(n) and pallas_kernels.usable()
+
+
 _BOOL_HLL = None
 
 
@@ -390,12 +398,27 @@ class _QuantileAnalyzerBase(ScanShareableAnalyzer):
                 "n": np.asarray([n], dtype=np.float64),
                 "level": np.asarray([level], dtype=np.int32),
             }
-        m = (
-            xp.asarray(inputs[f"valid:{self.column}"]).astype(x.dtype)
-            * xp.asarray(inputs[where_key(getattr(self, "where", None))]).astype(
-                x.dtype
-            )
-        )
+        live = xp.asarray(inputs[f"valid:{self.column}"]).astype(bool) & xp.asarray(
+            inputs[where_key(getattr(self, "where", None))]
+        ).astype(bool)
+        if (
+            inputs.get("__single_device")
+            and x.dtype == xp.float32
+            and _hist16_available(int(x.shape[0]))
+        ):
+            # TPU radix-select: the MXU builds the full 16-bit histogram
+            # of the sortable-key space (one-hot matmuls, ~1ns/row) and
+            # the HOST walks the 65536 counts, gathering only the bins
+            # that own a decimation rank (host_finish_batch) — replaces
+            # the O(n log^2 n) bitonic device sort entirely.
+            from deequ_tpu.ops import pallas_kernels
+
+            bins = pallas_kernels.f32_sortable_bin16(x, live)
+            return {
+                "hist16": pallas_kernels.hist16(bins),
+                "n": xp.sum(live.astype(x.dtype))[None],
+            }
+        m = live.astype(x.dtype)
         big = xp.asarray(xp.inf, dtype=x.dtype)
         vals = xp.where(m > 0, x, big)
         sorted_vals = xp.sort(vals)
@@ -420,9 +443,73 @@ class _QuantileAnalyzerBase(ScanShareableAnalyzer):
 
     def unshift_batch(self, out: Any, shifts) -> Any:
         s = shifts.get(f"num:{self.column}", 0.0)
-        if s == 0.0:
+        if s == 0.0 or "sample" not in out:
             return out
         return {**out, "sample": np.asarray(out["sample"], dtype=np.float64) + s}
+
+    def host_finish_batch(self, out: Any, host_inputs, shifts) -> Any:
+        """Finish the TPU hist16 radix-select: walk the 65536 counts to
+        the wanted decimation ranks, gather ONLY the owning bins from the
+        host-resident column, sort that sliver, read the samples off.
+        Exactly the decimated sample the device sort path would produce
+        (in the same float32 value space)."""
+        if "hist16" not in out:
+            return out
+        counts = np.asarray(out["hist16"], dtype=np.float64).reshape(65536)
+        # bins 65409..65535: positive-NaN key region (impossible for
+        # valid rows under the NaN==NULL contract) + the mask sentinel —
+        # never ranked. Bin 65408 is exactly +inf: kept.
+        counts[65409:] = 0.0
+        counts = counts.astype(np.int64)
+        n = int(counts.sum())
+        if n <= 0:
+            return {
+                "sample": np.zeros(0, dtype=np.float64),
+                "n": np.zeros(1, dtype=np.float64),
+                "level": np.zeros(1, dtype=np.int32),
+            }
+        cap = self._sample_size()
+        level = max(0, int(np.ceil(np.log2(max(n, 1) / cap))))
+        stride = 1 << level
+        offset = stride // 2
+        kept = max(0, -(-(n - offset) // stride))
+        ranks = offset + stride * np.arange(kept, dtype=np.int64)
+
+        cum = np.cumsum(counts)
+        bins_of_rank = np.searchsorted(cum, ranks, side="right")
+        wanted = np.zeros(65536, dtype=bool)
+        wanted[bins_of_rank] = True
+
+        # reproduce the wire's value space host-side: shifted float32
+        x = np.asarray(host_inputs[f"num:{self.column}"], dtype=np.float64)
+        valid = np.asarray(host_inputs[f"valid:{self.column}"], dtype=bool)
+        where = getattr(self, "where", None)
+        live = valid
+        if where is not None:
+            live = live & np.asarray(host_inputs[where_key(where)], dtype=bool)
+        shift = shifts.get(f"num:{self.column}", 0.0)
+        xs32 = (x - shift).astype(np.float32) if shift != 0.0 else x.astype(
+            np.float32
+        )
+        u = xs32.view(np.int32)
+        key = np.where(u < 0, ~u, u | np.int32(-(1 << 31)))
+        bin16 = (key >> 16) & 0xFFFF
+        sel = live & wanted[bin16]
+        gathered = np.sort(xs32[sel].astype(np.float64))
+
+        # rank within the gathered (wanted-bins-only) ordering: subtract
+        # the mass of NON-wanted bins below each rank's bin
+        unwanted_cum = np.cumsum(counts * ~wanted)
+        below = np.where(
+            bins_of_rank > 0, unwanted_cum[bins_of_rank - 1], 0
+        )
+        idx = ranks - below
+        sample = gathered[idx]
+        return {
+            "sample": sample,
+            "n": np.asarray([n], dtype=np.float64),
+            "level": np.asarray([level], dtype=np.int32),
+        }
 
     def host_consume(self, state: Optional[State], out: Any) -> Optional[State]:
         n = int(round(float(np.asarray(out["n"]).reshape(-1)[0])))

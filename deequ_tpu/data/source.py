@@ -218,10 +218,12 @@ class ParquetSource(DataSource):
             n for n, t in self._schema_cache if t == ColumnType.STRING
         ]
         with pq.ParquetFile(
-            self.path,
-            read_dictionary=str_cols or None,
-            memory_map=True,  # page-cache-warm reads skip a buffer copy
+            self.path, read_dictionary=str_cols or None
         ) as pf:
+            # NOTE: memory_map=True was tried and REVERTED: it saves a
+            # buffer copy (~3%) but maps the whole file into RSS, turning
+            # the bounded-memory contract's headline number (peak RSS)
+            # into file size.
             # One batch per row group (sliced down when a group exceeds
             # the cap). TINY groups (< size/4 — incremental writers often
             # produce 10k-row groups) still coalesce, or per-batch fold

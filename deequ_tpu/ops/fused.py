@@ -133,6 +133,11 @@ def get_fused_fn(
                     all_rows = jnp.arange(padded, dtype=jnp.int32) < n
                     for in_key in const_keys:
                         inputs[in_key] = all_rows
+            # trace-time marker: device-assisted members may use single-
+            # device-only strategies (e.g. the pallas hist16 radix-select,
+            # whose host finisher needs this batch's host inputs — not
+            # available per-shard in the mesh pass)
+            inputs["__single_device"] = True
             out = (
                 tuple(a.device_reduce(inputs, jnp) for a in analyzers),
                 tuple(a.device_batch(inputs, jnp) for a in assisted),
@@ -622,14 +627,17 @@ class PipelinedAggFold:
         self._assisted_states: List[Any] = [None] * len(self.assisted)
         self._pending = None
 
-    def submit(self, device_out, meta_box=None) -> None:
+    def submit(self, device_out, meta_box=None, host_ctx=None) -> None:
         jax.tree_util.tree_map(lambda x: x.copy_to_host_async(), device_out)
         if self._pending is not None:
             self._fold(self._pending)
-        self._pending = (device_out, meta_box)
+        # host_ctx (the batch's built inputs + wire shifts) stays alive
+        # until this batch folds: device-assisted members whose output is
+        # a summary (hist16) finish against the host-resident columns
+        self._pending = (device_out, meta_box, host_ctx)
 
     def _fold(self, pending) -> None:
-        device_out, meta_box = pending
+        device_out, meta_box, host_ctx = pending
         fetched = jax.device_get(device_out)
         if meta_box is not None:
             merge_out, assisted_out = unpack_outputs(fetched, meta_box["meta"])
@@ -649,6 +657,8 @@ class PipelinedAggFold:
                 shard = jax.tree_util.tree_map(
                     lambda x, d=d: np.asarray(x).reshape(self.n_dev, -1)[d], out
                 )
+                if host_ctx is not None and self.n_dev == 1:
+                    shard = analyzer.host_finish_batch(shard, host_ctx, shifts)
                 if shifts:
                     shard = analyzer.unshift_batch(shard, shifts)
                 self._assisted_states[i] = analyzer.host_consume(
@@ -871,7 +881,7 @@ class FusedScanPass:
                     # async dispatch: the device crunches this batch while
                     # the host folds the previous batch (and the host
                     # members below)
-                    fold.submit(fused(packed_inputs), meta_box)
+                    fold.submit(fused(packed_inputs), meta_box, host_ctx=built)
                 except Exception as e:  # noqa: BLE001
                     device_error = e
             fold_host_batch(

@@ -109,3 +109,89 @@ def usable() -> bool:
             except Exception:  # noqa: BLE001 - compile/runtime failure
                 _USABLE = False
     return _USABLE
+
+
+# ---------------------------------------------------------------------------
+# hist16: full 16-bit histogram via MXU one-hot matmuls
+# ---------------------------------------------------------------------------
+#
+# The quantile sketch's device-side heavy step used to be a full XLA sort
+# (bitonic, ~25-100ns/elem on the VPU). The radix-select view only needs
+# COUNTS at 16-bit key granularity: hist[h, l] = #rows whose sortable-key
+# top byte is h and next byte is l. Per block that is
+#
+#     onehot_high^T @ onehot_low        -- a (256, B) x (B, 256) matmul
+#
+# i.e. pure MXU work (~65k MACs/row ≈ 1ns/row), accumulated across the
+# grid into one (256, 256) float32 tile. The host walks the 65536 counts
+# (256KB) to locate the wanted decimation ranks, then gathers and sorts
+# ONLY the few bins that own a rank — the same histogram-assisted
+# selection the host C kernel runs, with the counting on the TPU.
+# (Reference role: catalyst/StatefulApproxQuantile.scala:28 — the
+# per-partition digest update this feeds.)
+
+_HIST_BINS = 256  # per axis; 256 x 256 = full 16-bit space
+
+
+def _hist16_kernel(bins_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    bins = bins_ref[:]  # (BLOCK_ROWS, 128) int32 in [0, 65536)
+    high = (bins >> 8) & 0xFF
+    low = bins & 0xFF
+    iota = jax.lax.broadcasted_iota(
+        jnp.int32, (_BLOCK_ROWS, 128, _HIST_BINS), 2
+    )
+    oh_high = (high[:, :, None] == iota).astype(jnp.float32)
+    oh_low = (low[:, :, None] == iota).astype(jnp.float32)
+    # per-sublane (256,128)x(128,256) matmuls batched over the sublane
+    # dim, summed on the VPU: mosaic's tpu.matmul wants standard 2-D
+    # contractions (a fused multi-dim contraction fails verification)
+    per_sublane = jax.lax.dot_general(
+        oh_high,
+        oh_low,
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # (BLOCK_ROWS, 256, 256)
+    block_hist = jnp.sum(per_sublane, axis=0)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros((_HIST_BINS, _HIST_BINS), dtype=jnp.float32)
+
+    out_ref[:] = out_ref[:] + block_hist
+
+
+def hist16(bins, interpret: bool = False):
+    """(256, 256) float32 histogram over 16-bit bin ids.
+
+    `bins` length must be a multiple of 1024 (`shape_supported`); rows
+    to exclude must carry the sentinel 65535 (the NaN region of the
+    float32 sortable-key space — real masked-in values never reach it),
+    which the host walk drops. Counts are exact in f32 up to 2^24 rows.
+    """
+    from jax.experimental import pallas as pl
+
+    n = bins.shape[0]
+    grid = n // _BLOCK
+    bins2d = bins.reshape(grid * _BLOCK_ROWS, 128).astype(jnp.int32)
+    return pl.pallas_call(
+        _hist16_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, 128), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_HIST_BINS, _HIST_BINS), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((_HIST_BINS, _HIST_BINS), jnp.float32),
+        interpret=interpret,
+    )(bins2d)
+
+
+def f32_sortable_bin16(values_f32, live_mask):
+    """Top-16 sortable-key bins for float32 values (order-preserving:
+    bin ascending == value ascending); excluded rows get sentinel 65535.
+    Pure XLA VPU ops — runs inside the fused program before hist16."""
+    u = jax.lax.bitcast_convert_type(values_f32, jnp.int32)
+    key = jnp.where(u < 0, ~u, u | jnp.int32(-2147483648))
+    bins = jax.lax.shift_right_logical(key, jnp.int32(16))
+    return jnp.where(live_mask, bins, jnp.int32(65535))

@@ -4,6 +4,7 @@ TPU hardware `usable()` turns it on inside the fused scan)."""
 
 from __future__ import annotations
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -92,3 +93,79 @@ class TestInterpretModeEquivalence:
         np.testing.assert_array_equal(got, expected)
         # and the estimate built from them is the production estimate
         assert hll.estimate(got) == hll.estimate(expected)
+
+
+class TestHist16RadixSelect:
+    """The MXU histogram kernel (one-hot matmuls -> full 16-bit count
+    table) + host walk must reproduce the device sort path's decimated
+    sample EXACTLY (same ranks in the same float32 value space)."""
+
+    def test_hist16_counts_match_bincount(self):
+        rng = np.random.default_rng(0)
+        n = 8192
+        x = (rng.lognormal(0, 2, n) * np.where(rng.random(n) < 0.4, -1, 1)).astype(
+            np.float32
+        )
+        live = rng.random(n) > 0.1
+        bins = np.asarray(
+            pallas_kernels.f32_sortable_bin16(jnp.asarray(x), jnp.asarray(live))
+        )
+        hist = np.asarray(
+            pallas_kernels.hist16(jnp.asarray(bins), interpret=True)
+        ).reshape(65536)
+        u = x.view(np.int32)
+        key = np.where(u < 0, ~u, u | np.int32(-(1 << 31)))
+        ref_bins = np.where(live, (key.astype(np.int64) >> 16) & 0xFFFF, 65535)
+        ref = np.bincount(ref_bins, minlength=65536)
+        assert np.array_equal(hist.astype(np.int64), ref)
+        # bin order must follow value order (sortable-key property)
+        order = np.argsort(x[live], kind="stable")
+        assert (np.diff(ref_bins[live][order]) >= 0).all()
+
+    def test_quantile_path_equals_sort_path(self, monkeypatch):
+        """End-to-end through the f32 device engine: the hist16 path's
+        samples equal the sort path's (identical decimation ranks in the
+        identical value space), so the resulting quantiles match
+        exactly. Engagement is asserted, not assumed."""
+        import deequ_tpu.analyzers.sketch as sketch_mod
+        from deequ_tpu.analyzers import ApproxQuantile
+        from deequ_tpu.data.table import Table
+        from deequ_tpu.ops import runtime
+        from deequ_tpu.ops.fused import FusedScanPass
+
+        monkeypatch.setattr(runtime, "compute_dtype", lambda: jnp.float32)
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "device")
+
+        rng = np.random.default_rng(8)
+        n = 50_000
+        x = rng.lognormal(3, 1, n)
+        x[rng.random(n) < 0.05] = np.nan
+        x = x * np.where(rng.random(n) < 0.3, -1, 1)
+
+        calls = {"hist16": 0}
+        real_hist16 = pallas_kernels.hist16
+
+        def interpreted_hist16(bins, interpret=False):
+            calls["hist16"] += 1
+            return real_hist16(bins, interpret=True)
+
+        def run(seed, use_hist):
+            sketch_mod._BATCH_SEED_COUNTER = __import__("itertools").count(seed)
+            if use_hist:
+                monkeypatch.setattr(
+                    sketch_mod, "_hist16_available", lambda n: True
+                )
+                monkeypatch.setattr(pallas_kernels, "hist16", interpreted_hist16)
+            else:
+                monkeypatch.setattr(
+                    sketch_mod, "_hist16_available", lambda n: False
+                )
+            t = Table.from_numpy({"x": x})
+            res = FusedScanPass([ApproxQuantile("x", 0.5)]).run(t)
+            state = res[0].state_or_raise()
+            return res[0].analyzer.compute_metric_from(state).value.get()
+
+        via_hist = run(1000, True)
+        assert calls["hist16"] >= 1  # the kernel actually ran
+        via_sort = run(1000, False)
+        assert via_hist == via_sort, (via_hist, via_sort)
