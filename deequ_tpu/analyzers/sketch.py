@@ -67,10 +67,21 @@ class ApproxCountDistinctState(DoubleValuedState):
 
 def _hist16_available(n: int) -> bool:
     """Pallas hist16 usable for this batch shape (TPU platform + block
-    multiple); interpret-mode tests monkeypatch this."""
+    multiple); interpret-mode tests monkeypatch this.
+
+    The n <= 2^24 cap keeps the kernel exact: hist16 accumulates bin
+    counts in float32 (MXU tiles), which counts exactly only up to
+    2^24 per bin. A low-cardinality column in an oversized explicit
+    FusedScanPass(batch_size=...) batch could push one bin past that
+    and silently corrupt counts/ranks, so such batches fall back to
+    the sort path instead."""
     from deequ_tpu.ops import pallas_kernels
 
-    return pallas_kernels.shape_supported(n) and pallas_kernels.usable()
+    return (
+        n <= (1 << 24)
+        and pallas_kernels.shape_supported(n)
+        and pallas_kernels.usable()
+    )
 
 
 _BOOL_HLL = None

@@ -66,23 +66,59 @@ class InMemoryStateProvider(StateLoader, StatePersister):
         return f"InMemoryStateProvider({keys})"
 
 
-def _scala_murmur3_string_hash(s: str) -> int:
-    """scala.util.hashing.MurmurHash3.stringHash(s) — the hash the
-    reference uses to name state files
-    (reference: analyzers/StateProvider.scala:81-83). Characters are
-    consumed in UTF-16 code-unit pairs ((c[i] << 16) | c[i+1]) with the
-    stringSeed 0xf7ca7fd2, then the standard murmur3 x86_32
-    finalization. Implemented from the published algorithm; there is no
-    JVM in this image to cross-validate against, so reference-side
-    interop should be smoke-tested once before relying on it (see
-    README 'State-file interop')."""
-    c1, c2 = 0xCC9E2D51, 0x1B873593
-    mask = 0xFFFFFFFF
+_MM3_C1 = 0xCC9E2D51
+_MM3_C2 = 0x1B873593
+_MASK32 = 0xFFFFFFFF
 
-    def rotl(value: int, amount: int) -> int:
-        return ((value << amount) | (value >> (32 - amount))) & mask
 
-    h = 0xF7CA7FD2  # MurmurHash3.stringSeed
+def _mm3_rotl(value: int, amount: int) -> int:
+    return ((value << amount) | ((value & _MASK32) >> (32 - amount))) & _MASK32
+
+
+def _mm3_mix_k(k: int) -> int:
+    """The murmur3 x86_32 block premix: k*c1, rotl15, k*c2."""
+    k = (k * _MM3_C1) & _MASK32
+    k = _mm3_rotl(k, 15)
+    return (k * _MM3_C2) & _MASK32
+
+
+def _mm3_mix(h: int, data: int) -> int:
+    """One full murmur3 x86_32 mix round (MurmurHash3.mix)."""
+    h ^= _mm3_mix_k(data)
+    h = _mm3_rotl(h, 13)
+    return (h * 5 + 0xE6546B64) & _MASK32
+
+
+def _mm3_mix_last(h: int, data: int) -> int:
+    """Tail mix without the h-side rotation (MurmurHash3.mixLast)."""
+    return h ^ _mm3_mix_k(data)
+
+
+def _mm3_finalize(h: int, length: int) -> int:
+    """MurmurHash3.finalizeHash: xor in the length, then avalanche."""
+    h ^= length
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _MASK32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _MASK32
+    h ^= h >> 16
+    return h
+
+
+def _scala_murmur3_string_hash(s: str, seed: int = 42) -> int:
+    """scala.util.hashing.MurmurHash3.stringHash(s, seed) — the hash the
+    reference uses to name state files, with the explicit seed 42 from
+    its call site (reference: analyzers/StateProvider.scala:81-83,
+    ``MurmurHash3.stringHash(analyzer.toString, 42)``). Characters are
+    consumed in UTF-16 code-unit pairs ((c[i] << 16) + c[i+1]) through
+    the standard murmur3 x86_32 mix rounds; an odd final unit goes
+    through mixLast; finalizeHash xors in the code-unit count. The mix/
+    finalize primitives are validated against published murmur3 x86_32
+    test vectors and hand-derived stringHash values in
+    tests/test_persistence.py; there is no JVM in this image, so a
+    one-time reference-side smoke test is still documented in README
+    ('State-file interop')."""
+    h = seed & _MASK32
     # Java charAt/length operate on UTF-16 CODE UNITS: derive them
     # explicitly so non-BMP characters (surrogate pairs on the JVM)
     # hash identically
@@ -92,25 +128,11 @@ def _scala_murmur3_string_hash(s: str) -> int:
     ]
     i = 0
     while i + 1 < len(units):
-        data = ((units[i] << 16) | units[i + 1]) & mask
-        k = (data * c1) & mask
-        k = rotl(k, 15)
-        k = (k * c2) & mask
-        h ^= k
-        h = rotl(h, 13)
-        h = (h * 5 + 0xE6546B64) & mask
+        h = _mm3_mix(h, ((units[i] << 16) + units[i + 1]) & _MASK32)
         i += 2
     if i < len(units):
-        k = (units[i] * c1) & mask
-        k = rotl(k, 15)
-        k = (k * c2) & mask
-        h ^= k
-    h ^= len(units)
-    h ^= h >> 16
-    h = (h * 0x85EBCA6B) & mask
-    h ^= h >> 13
-    h = (h * 0xC2B2AE35) & mask
-    h ^= h >> 16
+        h = _mm3_mix_last(h, units[i])
+    h = _mm3_finalize(h, len(units))
     # Scala's Int is signed
     return h - (1 << 32) if h >= (1 << 31) else h
 

@@ -159,13 +159,31 @@ class FsspecFileSystem(FileSystem):
     """Adapter over a user-supplied fsspec filesystem instance (s3fs,
     gcsfs, adlfs, ...). fsspec itself is never imported here — the
     caller passes the instance, this class only calls its standard
-    methods. Object stores publish atomically per object; for
-    POSIX-like fsspec backends the tmp+rename contract is preserved
-    when the backend supports `mv`."""
+    methods.
 
-    def __init__(self, fs, rename_atomic: bool = False):
+    Atomicity contract: object stores (s3/gcs/...) publish each object
+    atomically, so in-place writes are already crash-safe there. On
+    POSIX-like fsspec backends an in-place write that crashes midway
+    leaves a TRUNCATED file that later reads as corrupt rather than
+    absent — those backends need ``rename_atomic=True`` (tmp file +
+    ``fs.mv``). The default (``rename_atomic=None``) auto-detects:
+    tmp+mv when the backend's ``protocol`` names a local/posix
+    filesystem, plain in-place write otherwise (object-store ``mv`` is
+    a non-atomic copy+delete, so forcing it there would make things
+    worse, not better)."""
+
+    _POSIX_PROTOCOLS = frozenset({"file", "local"})
+
+    def __init__(self, fs, rename_atomic: "bool | None" = None):
         self._fs = fs
-        self._rename_atomic = rename_atomic
+        if rename_atomic is None:
+            protocol = getattr(fs, "protocol", ())
+            if isinstance(protocol, str):
+                protocol = (protocol,)
+            rename_atomic = bool(
+                set(protocol) & self._POSIX_PROTOCOLS
+            ) and hasattr(fs, "mv")
+        self._rename_atomic = bool(rename_atomic)
 
     def exists(self, path: str) -> bool:
         return bool(self._fs.exists(path))
@@ -177,9 +195,16 @@ class FsspecFileSystem(FileSystem):
     def write_bytes(self, path: str, data: bytes) -> None:
         if self._rename_atomic:
             tmp = f"{path}.{uuid.uuid4().hex}.tmp"
-            with self._fs.open(tmp, "wb") as f:
-                f.write(data)
-            self._fs.mv(tmp, path)
+            try:
+                with self._fs.open(tmp, "wb") as f:
+                    f.write(data)
+                self._fs.mv(tmp, path)
+            except BaseException:
+                try:
+                    self._fs.rm(tmp)
+                except Exception:  # noqa: BLE001 - best-effort tmp cleanup
+                    pass
+                raise
         else:
             with self._fs.open(path, "wb") as f:
                 f.write(data)

@@ -97,6 +97,70 @@ class TestScalarStateGoldenBytes:
         blob = serialize_state(Size(), NumMatches(1))
         assert blob == b"\x00\x00\x00\x00\x00\x00\x00\x01"
 
+    def test_hand_derived_literal_goldens_per_format(self):
+        """Literal byte goldens hand-derived from the reference layout
+        spec (StateProvider.scala:85-174): big-endian Java primitives,
+        IEEE-754 doubles written out by hand (2.5 = 0x4004<<48,
+        10.5 = 0x4025<<48, 1.25 = 0x3FF4<<48, ...). Nothing here calls
+        struct or the serializer to produce the expected side — these
+        bytes were derived on paper, so a shared encoding bug in both
+        producer and expectation cannot hide."""
+        # Size → one big-endian long: 12345 = 0x3039
+        assert serialize_state(Size(), NumMatches(12345)) == (
+            b"\x00\x00\x00\x00\x00\x00\x30\x39"
+        )
+        # Completeness → (matches, count) two longs: (7, 9)
+        assert serialize_state(
+            Completeness("c"), NumMatchesAndCount(7, 9)
+        ) == (
+            b"\x00\x00\x00\x00\x00\x00\x00\x07"
+            b"\x00\x00\x00\x00\x00\x00\x00\x09"
+        )
+        # Sum → one double: 2.5 = sign 0, exp 1024 (0x400), mantissa
+        # .25 → 0x4004000000000000
+        assert serialize_state(Sum("c"), SumState(2.5)) == (
+            b"\x40\x04\x00\x00\x00\x00\x00\x00"
+        )
+        # Mean → double + long: 10.5 = 0x4025000000000000, count 4
+        assert serialize_state(Mean("c"), MeanState(10.5, 4)) == (
+            b"\x40\x25\x00\x00\x00\x00\x00\x00"
+            b"\x00\x00\x00\x00\x00\x00\x00\x04"
+        )
+        # StdDev → three doubles (n, avg, m2) = (4.0, 2.5, 1.25):
+        # 4.0 = 0x4010…, 2.5 = 0x4004…, 1.25 = 0x3FF4…
+        assert serialize_state(
+            StandardDeviation("c"), StandardDeviationState(4.0, 2.5, 1.25)
+        ) == (
+            b"\x40\x10\x00\x00\x00\x00\x00\x00"
+            b"\x40\x04\x00\x00\x00\x00\x00\x00"
+            b"\x3f\xf4\x00\x00\x00\x00\x00\x00"
+        )
+        # Correlation → six doubles (n,xAvg,yAvg,ck,xMk,yMk) =
+        # (3.0, 1.0, 2.0, 0.5, 0.25, 0.125) = 0x4008…, 0x3FF0…,
+        # 0x4000…, 0x3FE0…, 0x3FD0…, 0x3FC0…
+        assert serialize_state(
+            Correlation("a", "b"),
+            CorrelationState(3.0, 1.0, 2.0, 0.5, 0.25, 0.125),
+        ) == (
+            b"\x40\x08\x00\x00\x00\x00\x00\x00"
+            b"\x3f\xf0\x00\x00\x00\x00\x00\x00"
+            b"\x40\x00\x00\x00\x00\x00\x00\x00"
+            b"\x3f\xe0\x00\x00\x00\x00\x00\x00"
+            b"\x3f\xd0\x00\x00\x00\x00\x00\x00"
+            b"\x3f\xc0\x00\x00\x00\x00\x00\x00"
+        )
+        # DataType → int length prefix 40 (0x28) + five longs
+        assert serialize_state(
+            DataType("c"), DataTypeHistogram(1, 2, 3, 4, 5)
+        ) == (
+            b"\x00\x00\x00\x28"
+            b"\x00\x00\x00\x00\x00\x00\x00\x01"
+            b"\x00\x00\x00\x00\x00\x00\x00\x02"
+            b"\x00\x00\x00\x00\x00\x00\x00\x03"
+            b"\x00\x00\x00\x00\x00\x00\x00\x04"
+            b"\x00\x00\x00\x00\x00\x00\x00\x05"
+        )
+
 
 class TestHllGoldenLayout:
     def test_words_are_length_prefixed_52_longs(self):
