@@ -849,6 +849,22 @@ from deequ_tpu.ops.strings import (  # noqa: E402
 )
 
 
+def _classified_dict(col) -> np.ndarray:
+    """int8 class code per dictionary entry, memoized on the ROOT column
+    (one classify pass per table; batches share the whole dictionary —
+    consumed by both the per-row dtclass codes and the counts-based
+    DataType shortcut)."""
+    from deequ_tpu.data.table import cached_column_encode
+    from deequ_tpu.ops.strings import classify
+
+    return cached_column_encode(
+        col,
+        "dtclassdict",
+        lambda c: classify(np.asarray(c.dict_encode()[1])).astype(np.int8),
+        slicer=lambda v, start, stop: v,
+    )
+
+
 def _dtclass_spec(column: str) -> InputSpec:
     def compute(col) -> np.ndarray:
         from deequ_tpu.ops.strings import classify
@@ -859,9 +875,9 @@ def _dtclass_spec(column: str) -> InputSpec:
             # wire format and the host bincount fast path
             from deequ_tpu.data.table import gather_with_null
 
-            dict_codes, uniques = col.dict_encode()
+            dict_codes, _uniques = col.dict_encode()
             return gather_with_null(
-                classify(uniques).astype(np.int8), dict_codes, _CODE_NULL
+                _classified_dict(col), dict_codes, _CODE_NULL
             )
         # typed columns classify statically from the stringified form
         static = {
@@ -908,10 +924,30 @@ class DataType(ScanShareableAnalyzer):
         return [_dtclass_spec(self.column), where_spec(self.where), where_spec(None)]
 
     def device_reduce(self, inputs: Dict[str, Any], xp) -> Any:
+        labels = ("null", "fractional", "integral", "boolean", "string")
+        if xp is np and self.where is None:
+            # a _LowCardCounts member counted this column's dictionary
+            # this batch: classify the DICTIONARY and weigh the classes
+            # by the per-entry counts — O(#uniques), and the per-row
+            # class-code input is never built at all (lazy HostInputs)
+            from deequ_tpu.ops import counts_family
+
+            lcc = inputs.get(f"__lcccounts:{self.column}")
+            if lcc is not None and counts_family.enabled():
+                counts, uniques, n_batch = lcc
+                rows_arr = np.asarray(inputs[where_key(None)], dtype=bool)
+                if n_batch == len(rows_arr) and bool(rows_arr.all()):
+                    cls = self._classified_dictionary(inputs, uniques)
+                    counts_vec = np.zeros(len(labels), dtype=np.int64)
+                    np.add.at(counts_vec, cls, np.asarray(counts[1:]))
+                    counts_vec[_CODE_NULL] += int(counts[0])
+                    return {
+                        label: float(counts_vec[code])
+                        for code, label in enumerate(labels)
+                    }
         codes = xp.asarray(inputs[f"dtclass:{self.column}"])
         w = inputs[where_key(self.where)]
         rows = inputs[where_key(None)]
-        labels = ("null", "fractional", "integral", "boolean", "string")
         if xp is np:
             # host fold: one bincount pass instead of 5 comparison scans;
             # where-filtered rows count as NULL class (conditionalSelection
@@ -952,6 +988,23 @@ class DataType(ScanShareableAnalyzer):
         for code, label in enumerate(labels):
             counts[label] = xp.sum(_f(xp, codes == code) * rows_f)
         return counts
+
+    def _classified_dictionary(self, inputs, uniques) -> np.ndarray:
+        """int8 class code per dictionary entry via the shared
+        `_classified_dict` memo when the batch is reachable (one
+        classify per table, shared with the per-row dtclass spec);
+        plain classify otherwise."""
+        from deequ_tpu.ops.strings import classify
+
+        batch = getattr(inputs, "batch", None)
+        if batch is not None:
+            try:
+                cls = _classified_dict(batch.column(self.column))
+                if len(cls) == len(uniques):
+                    return cls
+            except Exception:  # noqa: BLE001 - fall back to direct classify
+                pass
+        return classify(np.asarray(uniques)).astype(np.int8)
 
     def merge_agg(self, a: Any, b: Any, xp) -> Any:
         return {k: a[k] + b[k] for k in a}

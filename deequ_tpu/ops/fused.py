@@ -385,9 +385,10 @@ def fold_host_batch(
     _precompute_family_kernels(
         built,
         host_assisted,
-        batch if streaming else None,
+        batch,
         host_members=host_members,
         host_errors=host_errors,
+        streaming=streaming,
     )
     # assisted members fold FIRST: some publish per-batch memos that
     # merge members answer from (e.g. _LowCardCounts' dictionary
@@ -466,12 +467,77 @@ def _family_hll_mode(batch, column: str):
     return 0, None
 
 
+def _counts_family_shortcut(
+    built, batch, column, where, wkey, cap, want_regs, qkey, mkey, rkey
+) -> bool:
+    """Try the counts-based family path (ops/counts_family) for a
+    low-range integer column: ONE windowed count pass replaces the
+    select kernel's two, and the family memos (moments, decimated
+    sample, HLL registers) derive from the counts table in O(#bins).
+    Returns True when the memos were published (the select job is then
+    skipped); False falls through to the regular kernel. Never touches
+    `num:{column}` — on success the f64 view is never built at all."""
+    from deequ_tpu.data.table import ColumnType
+    from deequ_tpu.ops import counts_family
+
+    if batch is None:
+        return False
+    try:
+        col = batch.column(column)
+    except Exception:  # noqa: BLE001 - missing column: let the member fail
+        return False
+    if col.ctype != ColumnType.LONG:
+        return False
+    values = np.asarray(col.values)
+    if values.dtype != np.int64:
+        return False
+    try:
+        valid = np.asarray(built[f"valid:{column}"])
+        warr = None if where is None else np.asarray(built[wkey])
+    except Exception:  # noqa: BLE001 - input build failure: regular path
+        return False
+    if valid.dtype != np.bool_ or len(valid) != len(values):
+        return False
+    if warr is not None and (
+        warr.dtype != np.bool_ or len(warr) != len(values)
+    ):
+        return False
+    res = counts_family.counts_for_column(values, valid, warr)
+    if res is None:
+        return False
+    counts, lo, _n_valid, n_where = res
+    if warr is None:
+        n_where = len(values)
+    mom, sample, n_valid, level, regs = counts_family.family_from_counts(
+        counts, lo, cap, n_where, want_regs
+    )
+    built[qkey] = {
+        "sample": sample,
+        "n": np.asarray([n_valid], dtype=np.float64),
+        "level": np.asarray([level], dtype=np.int32),
+    }
+    if regs is not None:
+        built[rkey] = regs
+    if mkey not in built:
+        built[mkey] = {
+            "count": float(mom[0]),
+            "sum": float(mom[1]),
+            "min": float(mom[2]),
+            "max": float(mom[3]),
+            "m2": float(mom[4]),
+            "n_where": float(mom[5]),
+            "n_rows": float(len(values)),
+        }
+    return True
+
+
 def _precompute_family_kernels(
     built: Dict[str, np.ndarray],
     host_assisted,
     batch=None,
     host_members=(),
     host_errors=(),
+    streaming: bool = False,
 ) -> None:
     """Host-fold scan sharing ACROSS analyzer kinds: when a quantile
     sketch rides the pass, one combined C traversal produces the
@@ -480,11 +546,14 @@ def _precompute_family_kernels(
     decimated sample, AND the column's HLL++ registers (consumed by
     ApproxCountDistinct, whose hash inputs then never get built at all
     under the lazy HostInputs map) — two passes over the column instead
-    of the seven that separate kernels would pay. Results land in the
-    per-batch memo keys the members already read; any failure simply
-    leaves the memos unset and each member computes on its own."""
+    of the seven that separate kernels would pay. Low-range INTEGER
+    columns skip even those two passes: one windowed count pass derives
+    the whole family from the value distribution (ops/counts_family).
+    Results land in the per-batch memo keys the members already read;
+    any failure simply leaves the memos unset and each member computes
+    on its own."""
     from deequ_tpu.analyzers.base import where_key
-    from deequ_tpu.ops import native
+    from deequ_tpu.ops import counts_family, native
 
     # HLL piggybacking is only worth the per-row hash when a host-folded
     # ApproxCountDistinct on the same (column, where) will consume it
@@ -494,6 +563,7 @@ def _precompute_family_kernels(
         if getattr(member, "name", "") == "ApproxCountDistinct"
         and i not in host_errors
     }
+    counts_ok = counts_family.enabled()
     jobs = []
     for i, member in host_assisted:
         if i in host_errors:
@@ -509,6 +579,17 @@ def _precompute_family_kernels(
         mkey = f"__moments:{column}:{wkey}"
         if qkey in built or any(j[0] == qkey for j in jobs):
             continue
+        rkey = f"__hllregs:{column}:{wkey}"
+        want_regs = (column, wkey) in acd_families
+        try:
+            shortcut = counts_ok and _counts_family_shortcut(
+                built, batch, column, where, wkey, cap, want_regs,
+                qkey, mkey, rkey,
+            )
+        except Exception:  # noqa: BLE001 - memo stays unset, select runs
+            shortcut = False
+        if shortcut:
+            continue
         try:
             x = np.asarray(built[f"num:{column}"])
             valid = np.asarray(built[f"valid:{column}"])
@@ -519,11 +600,10 @@ def _precompute_family_kernels(
                 continue
         except Exception:  # noqa: BLE001 - memo stays unset, members recompute
             continue
-        if (column, wkey) in acd_families:
+        if want_regs and streaming:
             hll_mode, hashvals = _family_hll_mode(batch, column)
         else:
             hll_mode, hashvals = 0, None
-        rkey = f"__hllregs:{column}:{wkey}"
         jobs.append((qkey, mkey, rkey, x, valid, warr, cap, hll_mode, hashvals))
 
     if not jobs:

@@ -141,6 +141,17 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_int64),
             ]
             fn.restype = None
+        lib.bincount_window_i64.argtypes = [
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.bincount_window_i64.restype = None
         lib.masked_select_decimate.argtypes = [
             ctypes.POINTER(ctypes.c_double),
             ctypes.POINTER(ctypes.c_uint8),
@@ -233,6 +244,46 @@ def masked_moments(
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
     )
     return out
+
+
+def bincount_window(
+    values: np.ndarray,
+    valid: Optional[np.ndarray],
+    where: Optional[np.ndarray],
+    lo: int,
+    nbins: int,
+):
+    """Dense windowed value counts for an int64 column in one pass:
+    (counts[nbins], n_valid_in_window, n_where), or None when the native
+    library is unavailable OR any valid&where value fell outside
+    [lo, lo + nbins) — the caller falls back to the select kernel.
+    The abort is immediate in-kernel, so a wrong window guess costs only
+    the scanned prefix."""
+    lib = _load()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, dtype=np.int64)
+    valid = _u8_ptr(valid)
+    where = _u8_ptr(where)
+    counts = np.zeros(int(nbins), dtype=np.int64)
+    meta = np.zeros(3, dtype=np.int64)
+    lib.bincount_window_i64(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if valid is not None
+        else None,
+        where.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if where is not None
+        else None,
+        len(values),
+        int(lo),
+        int(nbins),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        meta.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if meta[2]:
+        return None
+    return counts, int(meta[0]), int(meta[1])
 
 
 def bincount(

@@ -116,6 +116,43 @@ void bincount_i8(const int8_t *codes, const uint8_t *where, int64_t n,
     }
 }
 
+/* Windowed dense value counting for integer columns: counts[v - lo]++
+ * for rows passing the masks whose value lies in [lo, lo + nbins).
+ * Returns via meta: [0] = count of valid&where rows in-window,
+ * [1] = count of where rows (n when where == NULL), [2] = 1 when any
+ * valid&where value fell OUTSIDE the window (the pass aborts
+ * immediately: the caller falls back to the select kernel, so a
+ * speculative window on a wide-range column costs only the prefix it
+ * scanned). One such pass replaces a whole family-kernel radix select
+ * for low-range integer columns (the counts table answers moments,
+ * decimated quantile sample, HLL registers and value histogram in
+ * O(nbins) — see ops/fused.py counts fast path). */
+void bincount_window_i64(const int64_t *v, const uint8_t *valid,
+                         const uint8_t *where, int64_t n, int64_t lo,
+                         int64_t nbins, int64_t *counts, int64_t *meta) {
+    int64_t count = 0, n_where = 0;
+    meta[0] = 0;
+    meta[1] = where ? 0 : n;
+    meta[2] = 0;
+    for (int64_t i = 0; i < n; i++) {
+        if (where) {
+            if (!where[i]) continue;
+            n_where++;
+        }
+        if (valid && !valid[i]) continue;
+        /* unsigned subtraction: defined wraparound even at int64 extremes */
+        uint64_t idx = (uint64_t)v[i] - (uint64_t)lo;
+        if (idx >= (uint64_t)nbins) {
+            meta[2] = 1;
+            return;
+        }
+        counts[idx]++;
+        count++;
+    }
+    meta[0] = count;
+    if (where) meta[1] = n_where;
+}
+
 /* Fused masked numeric moments: one data traversal feeds Mean, Sum,
  * Minimum, Maximum, StandardDeviation and the count of a whole
  * (column, where) family — the reductions the reference pushes into one

@@ -196,10 +196,15 @@ class _LowCardCounts(ScanShareableAnalyzer):
             ).astype(np.int64)
         # side-products for this string column: which dictionary entries
         # actually occur (ApproxCountDistinct builds registers over the
-        # PRESENT uniques instead of a full-row scatter) and the null
-        # count (Completeness answers without a popcount)
+        # PRESENT uniques instead of a full-row scatter), the null
+        # count (Completeness answers without a popcount), and the full
+        # per-entry counts (DataType classifies the dictionary and
+        # weighs the classes by these counts; _OptimisticNumericStats
+        # derives the whole numeric family from them — both in
+        # O(#uniques) instead of an O(rows) pass)
         inputs[f"__lccpresence:{self.column}"] = (counts[1:] > 0, uniques)
         inputs[f"__lccnulls:{self.column}"] = (int(counts[0]), len(codes))
+        inputs[f"__lcccounts:{self.column}"] = (counts, uniques, len(codes))
         if aborted:
             # cap blown: no histogram for this column, skip dict building
             return {"aborted": True}
@@ -355,7 +360,98 @@ class _OptimisticNumericStats(ScanShareableAnalyzer):
             ),
         ]
 
+    def _from_counts(self, inputs: Dict[str, Any], lcc) -> Optional[Any]:
+        """Derive the whole numeric-stat bundle from a _LowCardCounts
+        dictionary-counts side-product: parse the DICTIONARY once and
+        take weighted moments + rank-gathered decimation sample over
+        (parsed value, count) pairs — O(#uniques) instead of the per-row
+        cast + select-kernel pass. The sample is the exact
+        sorted-decimation contract (ties are interchangeable), the level
+        law mirrors the C kernel, and a parse failure on any PRESENT
+        entry reproduces the dead-state semantics of cast_or_dead."""
+        counts, uniques, _n_batch = lcc
+        counts = np.asarray(counts)
+        cs_all = counts[1:]
+        uniques = np.asarray(uniques, dtype=object)
+        if len(cs_all) != len(uniques):
+            return None
+
+        def parse_dict(col):
+            from deequ_tpu.ops.strings import parse_floats
+
+            return parse_floats(np.asarray(col.dict_encode()[1], dtype=object))
+
+        batch = getattr(inputs, "batch", None)
+        try:
+            if batch is not None:
+                from deequ_tpu.data.table import cached_column_encode
+
+                u_vals, u_ok = cached_column_encode(
+                    batch.column(self.column),
+                    "optnumdict",
+                    parse_dict,
+                    slicer=lambda v, start, stop: v,
+                )
+            else:
+                from deequ_tpu.ops.strings import parse_floats
+
+                u_vals, u_ok = parse_floats(uniques)
+        except Exception:  # noqa: BLE001 - fall back to the per-row path
+            return None
+        if len(u_vals) != len(cs_all):
+            return None
+        present = cs_all > 0
+        if np.any(present & ~np.asarray(u_ok, dtype=bool)):
+            return {"dead": True}
+        cs = cs_all[present]
+        vals = np.asarray(u_vals, dtype=np.float64)[present]
+        m = int(cs.sum())
+        cap = self._cap()
+        if m == 0:
+            return {
+                "dead": False, "count": 0.0, "sum": 0.0,
+                "min": float("inf"), "max": float("-inf"), "m2": 0.0,
+                "sample": np.zeros(0), "n": 0, "level": 0,
+            }
+        order = np.argsort(vals)
+        vals = vals[order]
+        cs = cs[order]
+        total = float(np.dot(cs.astype(np.longdouble), vals))
+        avg = total / m
+        delta = vals - avg
+        m2 = float(np.dot(cs.astype(np.longdouble), (delta * delta)))
+        level = 0
+        while (cap << level) < m:
+            level += 1
+        stride = 1 << level
+        offset = stride >> 1
+        kept = max(0, (m - offset + stride - 1) // stride)
+        if kept:
+            ranks = offset + stride * np.arange(kept, dtype=np.int64)
+            positions = np.searchsorted(np.cumsum(cs), ranks, side="right")
+            sample = vals[positions]
+        else:
+            sample = np.zeros(0, dtype=np.float64)
+        return {
+            "dead": False,
+            "count": float(m),
+            "sum": total,
+            "min": float(vals[0]),
+            "max": float(vals[-1]),
+            "m2": m2,
+            "sample": sample,
+            "n": m,
+            "level": level,
+        }
+
     def device_batch(self, inputs: Dict[str, Any], xp) -> Any:
+        from deequ_tpu.ops import counts_family
+
+        lcc = inputs.get(f"__lcccounts:{self.column}")
+        if lcc is not None and counts_family.enabled():
+            out = self._from_counts(inputs, lcc)
+            if out is not None:
+                return out
         values = inputs[f"optnum:{self.column}"]
         cast_valid = inputs[f"optnumv:{self.column}"]
         if np.asarray(values).ndim == 0:

@@ -1,0 +1,278 @@
+"""Parity tests for the counts-based family fast paths (round 5):
+
+1. `ops/counts_family` — a low-range int64 column's fused moments,
+   decimated quantile sample and HLL registers derived from ONE windowed
+   count pass must match the select kernel (`masked_moments_select`)
+   output for output: sample/registers/min/max/count EXACTLY, sum
+   exactly for in-range integers, m2 within float tolerance.
+2. DataType-from-dictionary-counts — classifying the dictionary and
+   weighing by _LowCardCounts' per-entry counts must equal the per-row
+   classification bincount exactly (integer counts).
+3. _OptimisticNumericStats-from-counts — the numeric bundle for an
+   inferred-numeric string column derived from (parsed dictionary,
+   counts) must match the per-row cast + select path.
+4. End-to-end: ColumnProfiler output with the fast paths enabled equals
+   the output with DEEQU_TPU_NO_COUNTS_FASTPATH=1 (the pre-existing
+   per-row kernels) on a mixed table.
+
+Reference behavior being preserved: profiles/ColumnProfiler.scala
+:103-187 pass outputs; catalyst/StatefulDataType.scala classification
+counts; catalyst/StatefulApproxQuantile.scala per-partition updates.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deequ_tpu.ops import counts_family, native
+
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native kernels unavailable"
+)
+
+
+def _select_reference(vals, valid, where, cap, with_hll):
+    x = vals.astype(np.float64)
+    return native.masked_moments_select(
+        x,
+        valid,
+        where,
+        cap,
+        hll_mode=2 if with_hll else 0,
+        hashvals=vals if with_hll else None,
+    )
+
+
+@needs_native
+class TestCountsFamilyParity:
+    @pytest.mark.parametrize(
+        "case",
+        ["dense", "nulls", "where", "offset_base", "negative", "tiny",
+         "constant", "two_values"],
+    )
+    def test_matches_select_kernel(self, case):
+        seeds = {
+            "dense": 1, "nulls": 2, "where": 3, "offset_base": 4,
+            "negative": 5, "tiny": 6, "constant": 7, "two_values": 8,
+        }
+        rng = np.random.default_rng(seeds[case])
+        n = 200_000
+        valid = where = None
+        if case == "dense":
+            vals = rng.integers(1, 100, n)
+        elif case == "nulls":
+            vals = rng.integers(-50, 5000, n)
+            valid = rng.random(n) > 0.15
+        elif case == "where":
+            vals = rng.integers(0, 30, n)
+            valid = rng.random(n) > 0.05
+            where = rng.random(n) > 0.5
+        elif case == "offset_base":
+            vals = rng.integers(10**14, 10**14 + 20_000, n)
+        elif case == "negative":
+            vals = rng.integers(-30_000, -29_000, n)
+        elif case == "tiny":
+            vals = np.array([3, 1, 4, 1, 5])
+        elif case == "constant":
+            vals = np.full(n, 77)
+        else:  # two_values
+            vals = np.where(rng.random(n) > 0.7, 10, 20)
+        vals = vals.astype(np.int64)
+        cap = 460
+
+        res = counts_family.counts_for_column(vals, valid, where)
+        assert res is not None, case
+        counts, lo, n_valid, n_where = res
+        mom_c, sample_c, n_c, lvl_c, regs_c = counts_family.family_from_counts(
+            counts, lo, cap, n_where, want_regs=True
+        )
+        mom_r, sample_r, n_r, lvl_r, regs_r = _select_reference(
+            vals, valid, where, cap, with_hll=True
+        )
+        assert (n_c, lvl_c) == (n_r, lvl_r)
+        assert np.array_equal(sample_c, sample_r)
+        assert np.array_equal(regs_c, regs_r)
+        # count / min / max / n_where exact
+        assert mom_c[0] == mom_r[0]
+        assert mom_c[2] == mom_r[2] and mom_c[3] == mom_r[3]
+        assert mom_c[5] == mom_r[5]
+        # the counts-path sum is exact integer arithmetic; the kernel's
+        # long-double stream matches it bit-for-bit while the true total
+        # fits the accumulator, and to 1e-15 relative beyond that
+        # (offset_base: totals ~2e19 overflow even the 64-bit mantissa)
+        if abs(mom_r[1]) < float(1 << 53):
+            assert mom_c[1] == mom_r[1]
+        else:
+            assert mom_c[1] == pytest.approx(mom_r[1], rel=1e-15)
+        assert mom_c[4] == pytest.approx(mom_r[4], rel=1e-9, abs=1e-9)
+
+    def test_fallbacks(self):
+        rng = np.random.default_rng(0)
+        # wide range: probe refuses before any pass
+        wide = rng.integers(0, 10**12, 10_000).astype(np.int64)
+        assert counts_family.counts_for_column(wide, None, None) is None
+        # non-int64 columns are not eligible
+        assert (
+            counts_family.counts_for_column(
+                rng.random(1000), None, None
+            )
+            is None
+        )
+        # narrow probe but an unprobed outlier: the kernel aborts
+        trick = np.full(100_001, 5, dtype=np.int64)
+        trick[70_000] = 10**9  # outside head/middle/tail probes
+        assert counts_family.counts_for_column(trick, None, None) is None
+        # all-null column: no probe information
+        vals = rng.integers(0, 5, 1000).astype(np.int64)
+        assert (
+            counts_family.counts_for_column(
+                vals, np.zeros(1000, dtype=bool), None
+            )
+            is None
+        )
+
+    def test_int64_extreme_sentinels_stay_successful(self):
+        """Columns of Long.MIN/MAX-adjacent sentinels: the speculative
+        window must clamp inside int64 (no ctypes wrap, no OverflowError)
+        and the metrics must succeed either via the counts path or the
+        select fallback (regression: review round 5)."""
+        from deequ_tpu.analyzers import ApproxQuantiles, Mean
+        from deequ_tpu.data.table import Table
+        from deequ_tpu.runners import AnalysisRunner
+
+        for value in (-(1 << 63) + 5, (1 << 63) - 3):
+            t = Table.from_numpy(
+                {"x": np.full(5000, value, dtype=np.int64)}
+            )
+            res = (
+                AnalysisRunner.on_data(t)
+                .add_analyzers([Mean("x"), ApproxQuantiles("x", (0.5,))])
+                .run()
+            )
+            for _a, metric in res.metric_map.items():
+                assert metric.value.is_success, (value, metric.value)
+
+    def test_empty_after_masks(self):
+        # probed values exist but `where` excludes everything: counts
+        # all zero, family must report the empty-state shape
+        vals = np.arange(100, dtype=np.int64)
+        where = np.zeros(100, dtype=bool)
+        res = counts_family.counts_for_column(vals, None, where)
+        assert res is not None
+        counts, lo, n_valid, n_where = res
+        assert n_valid == 0 and n_where == 0
+        mom, sample, m, level, regs = counts_family.family_from_counts(
+            counts, lo, 460, n_where, want_regs=True
+        )
+        assert m == 0 and len(sample) == 0
+        assert mom[0] == 0.0 and mom[2] == np.inf and mom[3] == -np.inf
+        assert not regs.any()
+
+
+class TestDataTypeFromCounts:
+    def _datatype_agg(self, table, monkeypatch=None, disable=False):
+        from deequ_tpu.runners import AnalysisRunner
+        from deequ_tpu.analyzers import DataType
+
+        res = AnalysisRunner.on_data(table).add_analyzers([DataType("s")]).run()
+        (metric,) = res.metric_map.values()
+        return metric.value.get()
+
+    def test_matches_per_row_path(self, monkeypatch):
+        from deequ_tpu.data.table import Table
+        from deequ_tpu.profiles.column_profiler import ColumnProfiler
+
+        rng = np.random.default_rng(7)
+        pool = np.array(
+            ["12", "-3", "4.5", "true", "false", "zebra", "", "+8", " 9",
+             "7.", ".5", "NaN"],
+            dtype=object,
+        )
+        values = pool[rng.integers(0, len(pool), 20_000)]
+        values[rng.random(20_000) < 0.1] = None
+        table = Table.from_pydict({"s": values})
+
+        fast = ColumnProfiler.profile(table).profiles["s"]
+        monkeypatch.setenv("DEEQU_TPU_NO_COUNTS_FASTPATH", "1")
+        slow = ColumnProfiler.profile(
+            Table.from_pydict({"s": values})
+        ).profiles["s"]
+        assert fast.type_counts == slow.type_counts
+        assert fast.data_type == slow.data_type
+        assert fast.completeness == slow.completeness
+
+
+class TestProfilerEndToEndParity:
+    def test_mixed_table_profiles_equal(self, monkeypatch):
+        from deequ_tpu.data.table import Table
+        from deequ_tpu.profiles.column_profiler import ColumnProfiler
+
+        rng = np.random.default_rng(11)
+        n = 50_000
+        qty = rng.integers(1, 100, n).astype(np.int64)
+        price = rng.lognormal(1.0, 0.5, n)
+        price[rng.random(n) < 0.05] = np.nan
+        code = np.array(
+            [str(v) for v in rng.integers(0, 500, n)], dtype=object
+        )
+        cat = np.array(["a", "b", "c", "d"], dtype=object)[
+            rng.integers(0, 4, n)
+        ]
+        flag = rng.random(n) < 0.5
+
+        def build():
+            return Table.from_numpy(
+                {
+                    "qty": qty.copy(),
+                    "price": price.copy(),
+                    "code": code.copy(),
+                    "cat": cat.copy(),
+                    "flag": flag.copy(),
+                }
+            )
+
+        # pin the KLL batch-seed sequence: quantile compaction offsets
+        # are seeded from a process-global counter, so two otherwise
+        # identical runs must start from the same point to compare
+        import itertools
+
+        from deequ_tpu.analyzers import sketch as sketch_mod
+
+        monkeypatch.setattr(
+            sketch_mod, "_BATCH_SEED_COUNTER", itertools.count(1)
+        )
+        fast = ColumnProfiler.profile(build()).profiles
+        monkeypatch.setenv("DEEQU_TPU_NO_COUNTS_FASTPATH", "1")
+        monkeypatch.setattr(
+            sketch_mod, "_BATCH_SEED_COUNTER", itertools.count(1)
+        )
+        slow = ColumnProfiler.profile(build()).profiles
+        assert fast.keys() == slow.keys()
+        for name in fast:
+            f, s = fast[name], slow[name]
+            assert f.completeness == s.completeness, name
+            assert f.approximate_num_distinct_values == (
+                s.approximate_num_distinct_values
+            ), name
+            assert f.data_type == s.data_type, name
+            assert f.type_counts == s.type_counts, name
+            if getattr(f, "mean", None) is not None:
+                assert f.mean == pytest.approx(s.mean, rel=1e-12), name
+                assert f.minimum == s.minimum and f.maximum == s.maximum, name
+                assert f.sum == pytest.approx(s.sum, rel=1e-12), name
+                assert f.std_dev == pytest.approx(s.std_dev, rel=1e-9), name
+                fq = list(f.approx_percentiles or [])
+                sq = list(s.approx_percentiles or [])
+                assert len(fq) == len(sq) and len(fq) > 0, name
+                for i, (fv, sv) in enumerate(zip(fq, sq)):
+                    assert fv == pytest.approx(sv, rel=1e-9, abs=1e-12), (
+                        name,
+                        i,
+                    )
+            hf = getattr(f, "histogram", None)
+            hs = getattr(s, "histogram", None)
+            assert (hf is None) == (hs is None), name
+            if hf is not None:
+                assert hf.values == hs.values, name
