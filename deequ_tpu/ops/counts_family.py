@@ -103,6 +103,52 @@ def counts_for_column(
     return counts, lo, n_valid, n_where
 
 
+def weighted_moments_and_sample(
+    values_sorted: np.ndarray,
+    counts_sorted: np.ndarray,
+    cap: int,
+    exact_sum: "int | None" = None,
+):
+    """The kernel-parity core shared by every counts-based path: given
+    value-SORTED (distinct value, count) pairs, derive
+    (count, sum, min, max, m2), the decimated sample and the level —
+    mirroring the C kernel's decimation law
+    (``while (cap << level) < m: level++``; sample =
+    ``sorted(x)[stride/2::stride][:kept]`` via rank lookups into the
+    cumulative counts). `exact_sum` supplies an exactly-computed total
+    (integer paths); float paths take the weighted long-double dot."""
+    cs = counts_sorted
+    vs = values_sorted
+    m = int(cs.sum())
+    if m == 0:
+        return (
+            (0.0, 0.0, float("inf"), float("-inf"), 0.0),
+            np.zeros(0, dtype=np.float64),
+            0,
+            0,
+        )
+    if exact_sum is not None:
+        sum_d = float(exact_sum)
+    else:
+        sum_d = float(np.dot(cs.astype(np.longdouble), vs))
+    avg = sum_d / m
+    d = vs - avg
+    m2 = float(np.dot(cs.astype(np.longdouble), (d * d).astype(np.longdouble)))
+    level = 0
+    while (cap << level) < m:
+        level += 1
+    stride = 1 << level
+    offset = stride >> 1
+    kept = max(0, (m - offset + stride - 1) // stride)
+    if kept:
+        ranks = offset + stride * np.arange(kept, dtype=np.int64)
+        positions = np.searchsorted(np.cumsum(cs), ranks, side="right")
+        sample = vs[positions]
+    else:
+        sample = np.zeros(0, dtype=np.float64)
+    return (float(m), sum_d, float(vs[0]), float(vs[-1]), m2), sample, m, level
+
+
 def family_from_counts(
     counts: np.ndarray,
     lo: int,
@@ -118,52 +164,28 @@ def family_from_counts(
     ints = (nz + lo).astype(np.int64)
     vs = ints.astype(np.float64)
     m = int(cs.sum())
-    if m == 0:
-        mom = np.array(
-            [0.0, 0.0, np.inf, -np.inf, 0.0, float(n_where)], dtype=np.float64
-        )
-        regs0 = None
-        if want_regs:
-            from deequ_tpu.ops.sketches import hll
-
-            regs0 = np.zeros(hll.M, dtype=np.int32)
-        return mom, np.zeros(0, dtype=np.float64), 0, 0, regs0
-    # exact integer sum: products stay inside int64 when |value| < 2^31
-    # (counts are < 2^63 / 2^31); Python big ints otherwise
-    amax = max(abs(int(ints[0])), abs(int(ints[-1])))
-    if amax < (1 << 31):
-        total = int(np.dot(cs, ints))
+    if m > 0:
+        # exact integer sum: products stay inside int64 when
+        # |value| < 2^31 (counts are < 2^63 / 2^31); big ints otherwise
+        amax = max(abs(int(ints[0])), abs(int(ints[-1])))
+        if amax < (1 << 31):
+            total = int(np.dot(cs, ints))
+        else:
+            total = sum(int(c) * int(v) for c, v in zip(cs, ints))
     else:
-        total = sum(int(c) * int(v) for c, v in zip(cs, ints))
-    sum_d = float(total)
-    avg = sum_d / m
-    d = vs - avg
-    m2 = float(
-        np.dot(cs.astype(np.longdouble), (d * d).astype(np.longdouble))
+        total = 0
+    core, sample, m, level = weighted_moments_and_sample(
+        vs, cs, cap, exact_sum=total
     )
-    mom = np.array(
-        [float(m), sum_d, vs[0], vs[-1], m2, float(n_where)], dtype=np.float64
-    )
-    # decimation law, mirrored from sd_core (ops/native/xxhash_hll.c)
-    level = 0
-    while (cap << level) < m:
-        level += 1
-    stride = 1 << level
-    offset = stride >> 1
-    kept = max(0, (m - offset + stride - 1) // stride)
-    if kept:
-        ranks = offset + stride * np.arange(kept, dtype=np.int64)
-        positions = np.searchsorted(np.cumsum(cs), ranks, side="right")
-        sample = vs[positions]
-    else:
-        sample = np.zeros(0, dtype=np.float64)
+    mom = np.array(list(core) + [float(n_where)], dtype=np.float64)
     regs = None
     if want_regs:
         from deequ_tpu.ops.sketches import hll
 
-        packed = hll.pack_codes(ints, np.ones(len(ints), dtype=bool))
         regs = np.zeros(hll.M, dtype=np.int32)
-        np.maximum.at(
-            regs, packed >> 6, (packed & 0x3F).astype(np.int32)
-        )
+        if len(ints):
+            packed = hll.pack_codes(ints, np.ones(len(ints), dtype=bool))
+            np.maximum.at(
+                regs, packed >> 6, (packed & 0x3F).astype(np.int32)
+            )
     return mom, sample, m, level, regs

@@ -445,11 +445,12 @@ def _family_hll_mode(batch, column: str):
     (mode 2, via the original backing array — no float roundtrip).
     (0, None) when the identity can't be reproduced in-kernel.
 
-    Only STREAMING scans fold HLL this way (the caller passes
-    batch=None otherwise): in-memory tables amortize the hash+pack
-    across runs through the per-column encode cache, which is cheaper
-    than re-hashing inside every family-kernel call — a stream's batches
-    are fresh columns with nothing to amortize."""
+    Only STREAMING scans fold HLL this way — the CALLER gates on its
+    streaming flag (`_precompute_family_kernels`: `want_regs and
+    streaming`): in-memory tables amortize the hash+pack across runs
+    through the per-column encode cache, which is cheaper than
+    re-hashing inside every family-kernel call — a stream's batches are
+    fresh columns with nothing to amortize."""
     from deequ_tpu.data.table import ColumnType
 
     if batch is None:

@@ -403,41 +403,21 @@ class _OptimisticNumericStats(ScanShareableAnalyzer):
         present = cs_all > 0
         if np.any(present & ~np.asarray(u_ok, dtype=bool)):
             return {"dead": True}
+        from deequ_tpu.ops.counts_family import weighted_moments_and_sample
+
         cs = cs_all[present]
         vals = np.asarray(u_vals, dtype=np.float64)[present]
-        m = int(cs.sum())
-        cap = self._cap()
-        if m == 0:
-            return {
-                "dead": False, "count": 0.0, "sum": 0.0,
-                "min": float("inf"), "max": float("-inf"), "m2": 0.0,
-                "sample": np.zeros(0), "n": 0, "level": 0,
-            }
         order = np.argsort(vals)
-        vals = vals[order]
-        cs = cs[order]
-        total = float(np.dot(cs.astype(np.longdouble), vals))
-        avg = total / m
-        delta = vals - avg
-        m2 = float(np.dot(cs.astype(np.longdouble), (delta * delta)))
-        level = 0
-        while (cap << level) < m:
-            level += 1
-        stride = 1 << level
-        offset = stride >> 1
-        kept = max(0, (m - offset + stride - 1) // stride)
-        if kept:
-            ranks = offset + stride * np.arange(kept, dtype=np.int64)
-            positions = np.searchsorted(np.cumsum(cs), ranks, side="right")
-            sample = vals[positions]
-        else:
-            sample = np.zeros(0, dtype=np.float64)
+        core, sample, m, level = weighted_moments_and_sample(
+            vals[order], cs[order], self._cap()
+        )
+        count, total, vmin, vmax, m2 = core
         return {
             "dead": False,
-            "count": float(m),
+            "count": count,
             "sum": total,
-            "min": float(vals[0]),
-            "max": float(vals[-1]),
+            "min": vmin,
+            "max": vmax,
             "m2": m2,
             "sample": sample,
             "n": m,
