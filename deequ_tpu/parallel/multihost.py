@@ -200,11 +200,17 @@ def run_multihost_analysis(
     mesh=None,
     engine: str = "auto",
     gather=allgather_bytes,
+    save_states_with=None,
 ) -> AnalyzerContext:
     """Analyze this process's partition locally, then merge states across
     all processes; returns identical table-level metrics on every host
     (the distributed form of runOnAggregatedStates,
     reference: examples/UpdateMetricsOnPartitionedDataExample.scala:30-95).
+
+    `save_states_with` optionally receives the LOCAL (pre-merge) states
+    — callers that want to inspect or persist this host's partition
+    contribution (e.g. the dryrun asserting a spilled frequency state)
+    get them from the single analysis pass instead of recomputing.
 
     A failure on ANY host fails that analyzer's global metric on EVERY
     host — a partition that errored must not silently drop out of a
@@ -213,7 +219,10 @@ def run_multihost_analysis(
     from deequ_tpu.runners.analysis_runner import AnalysisRunner
 
     analyzers = _dedup(analyzers)
-    local_states = InMemoryStateProvider()
+    local_states = (
+        save_states_with if save_states_with is not None
+        else InMemoryStateProvider()
+    )
     local_context = AnalysisRunner.do_analysis_run(
         local_table,
         analyzers,

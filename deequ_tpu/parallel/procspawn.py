@@ -1,0 +1,115 @@
+"""Spawn-and-collect harness for REAL multi-process JAX runs.
+
+Shared by the two-process multihost test and the driver-facing
+`dryrun_multihost` (the composed ICI×DCN dry run) so the loopback
+coordinator scaffolding — free port, worker script on disk, Popen
+fan-out, RESULT-line protocol, diagnostic-preserving timeout — exists
+once. The workers are real OS processes running real
+`jax.distributed.initialize`, which is the only way to exercise the
+non-identity branch of `allgather_bytes` without a multi-host fleet.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+from typing import List, Sequence
+
+
+class WorkerFailure(RuntimeError):
+    """A worker exited non-zero or the cluster timed out; `details`
+    carries every worker's captured stderr tail for diagnosis (callers
+    that treat the multi-process runtime as optional catch this and
+    skip)."""
+
+    def __init__(self, message: str, details: str = ""):
+        super().__init__(message + ("\n" + details if details else ""))
+        self.details = details
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_worker_processes(
+    worker_source: str,
+    n_processes: int,
+    extra_args: Sequence[str] = (),
+    timeout: float = 240.0,
+) -> List[dict]:
+    """Run `worker_source` in n_processes real interpreters with argv
+    ``[rank, port, tmpdir, *extra_args]``; each worker must print one
+    ``RESULT:<json>`` line. Returns the parsed RESULT payloads in rank
+    order. Raises WorkerFailure (with every worker's stderr tail) on
+    non-zero exits, missing RESULT lines, or timeout — the timeout path
+    drains and reaps every process so no pipes or zombies leak."""
+    port = free_port()
+    env = dict(os.environ)
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    # workers pick their own platform/device count in code; an inherited
+    # forced host-device-count flag must not override them
+    env.pop("XLA_FLAGS", None)
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        worker_path = os.path.join(tmpdir, "worker.py")
+        with open(worker_path, "w") as f:
+            f.write(worker_source)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker_path, str(rank), str(port), tmpdir]
+                + [str(a) for a in extra_args],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                env=env,
+            )
+            for rank in range(n_processes)
+        ]
+        outs = []
+        timed_out = False
+        for p in procs:
+            try:
+                stdout, stderr = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                timed_out = True
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                # drain + reap everything so diagnostics survive and no
+                # zombies/pipes leak
+                stdout, stderr = p.communicate()
+            outs.append((p.returncode, stdout, stderr))
+        details = "\n---\n".join(
+            f"rank {i} rc={rc}:\n{err[-2000:]}"
+            for i, (rc, _out, err) in enumerate(outs)
+        )
+        if timed_out:
+            raise WorkerFailure(
+                f"{n_processes}-process JAX runtime timed out after "
+                f"{timeout:.0f}s",
+                details,
+            )
+        if any(rc != 0 for rc, _o, _e in outs):
+            raise WorkerFailure(
+                f"{n_processes}-process JAX worker failed", details
+            )
+        results = []
+        for rank, (_rc, stdout, _err) in enumerate(outs):
+            lines = [
+                l for l in stdout.splitlines() if l.startswith("RESULT:")
+            ]
+            if not lines:
+                raise WorkerFailure(
+                    f"rank {rank} produced no RESULT line", details
+                )
+            results.append(json.loads(lines[-1][len("RESULT:"):]))
+        return results
