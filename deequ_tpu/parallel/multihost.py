@@ -207,10 +207,15 @@ def run_multihost_analysis(
     (the distributed form of runOnAggregatedStates,
     reference: examples/UpdateMetricsOnPartitionedDataExample.scala:30-95).
 
-    `save_states_with` optionally receives the LOCAL (pre-merge) states
-    — callers that want to inspect or persist this host's partition
-    contribution (e.g. the dryrun asserting a spilled frequency state)
-    get them from the single analysis pass instead of recomputing.
+    `save_states_with` optionally receives a COPY of the LOCAL
+    (pre-merge) states — callers that want to inspect or persist this
+    host's partition contribution (e.g. the dryrun asserting a spilled
+    frequency state) get them from the single analysis pass instead of
+    recomputing. The merge itself always reads a FRESH internal
+    provider, so a reused/pre-populated caller provider can never leak
+    a previous run's state into this host's contribution (an empty
+    local state is never persisted, so it would not overwrite a stale
+    entry).
 
     A failure on ANY host fails that analyzer's global metric on EVERY
     host — a partition that errored must not silently drop out of a
@@ -219,10 +224,7 @@ def run_multihost_analysis(
     from deequ_tpu.runners.analysis_runner import AnalysisRunner
 
     analyzers = _dedup(analyzers)
-    local_states = (
-        save_states_with if save_states_with is not None
-        else InMemoryStateProvider()
-    )
+    local_states = InMemoryStateProvider()
     local_context = AnalysisRunner.do_analysis_run(
         local_table,
         analyzers,
@@ -230,6 +232,11 @@ def run_multihost_analysis(
         engine=engine,
         mesh=mesh,
     )
+    if save_states_with is not None:
+        for analyzer in analyzers:
+            state = local_states.load(analyzer)
+            if state is not None:
+                save_states_with.persist(analyzer, state)
     from deequ_tpu.core.exceptions import EmptyStateException
 
     # an all-NULL local partition is a legitimately empty contribution

@@ -22,13 +22,22 @@ from typing import List, Sequence
 
 class WorkerFailure(RuntimeError):
     """A worker exited non-zero or the cluster timed out; `details`
-    carries every worker's captured stderr tail for diagnosis (callers
-    that treat the multi-process runtime as optional catch this and
-    skip)."""
+    carries every worker's captured stderr tail for diagnosis.
+    `runtime_unavailable` distinguishes "the multi-process runtime
+    could not run here" (timeout / non-zero exit — callers that treat
+    it as optional may skip) from a PROTOCOL failure (a worker ran to
+    completion but broke the RESULT contract — always a real bug, never
+    an environment problem)."""
 
-    def __init__(self, message: str, details: str = ""):
+    def __init__(
+        self,
+        message: str,
+        details: str = "",
+        runtime_unavailable: bool = True,
+    ):
         super().__init__(message + ("\n" + details if details else ""))
         self.details = details
+        self.runtime_unavailable = runtime_unavailable
 
 
 def free_port() -> int:
@@ -109,7 +118,10 @@ def run_worker_processes(
             ]
             if not lines:
                 raise WorkerFailure(
-                    f"rank {rank} produced no RESULT line", details
+                    f"rank {rank} exited 0 but produced no RESULT line "
+                    "(broken worker protocol, not an environment issue)",
+                    details,
+                    runtime_unavailable=False,
                 )
             results.append(json.loads(lines[-1][len("RESULT:"):]))
         return results

@@ -1,0 +1,231 @@
+"""Full-suite differential fuzz (round-4 verdict item 8): random tables
+× random Check DSL programs through THREE execution paths — the
+single-device engine, the 8-device mesh engine, and the pure host fold
+— asserting end-to-end agreement of the VerificationSuite outputs:
+overall status, per-check status, per-constraint status, and the
+underlying metric values (exact for counts/statuses, 1e-9 for scalar
+floats, rank-error-loose for sketches).
+
+This is the VerificationSuite-level generalization of
+tests/test_differential_random.py (which fuzzes analyzers directly).
+Assertion thresholds for SKETCH-backed constraints are drawn far from
+plausible metric values so legitimate sketch randomization across merge
+trees can never flip a constraint status (the reference makes no
+cross-engine bit-equality promise for approximate metrics either).
+
+Reference end-to-end behavior being preserved:
+checks/CheckTest.scala (status semantics per DSL method),
+VerificationSuite.scala:263-281 (overall status = max over checks).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deequ_tpu.checks import Check, CheckLevel
+from deequ_tpu.constraints import ConstrainableDataTypes
+from deequ_tpu.data.table import ColumnType, Table
+from deequ_tpu.parallel.distributed import data_mesh
+from deequ_tpu.verification import VerificationSuite
+
+N_SEEDS = 44
+
+
+def random_table(rng: np.random.Generator) -> Table:
+    n = int(rng.integers(1, 2500))
+    null_density = float(rng.choice([0.0, 0.02, 0.4, 0.9]))
+    x = rng.normal(rng.uniform(-50, 50), rng.uniform(0.0, 20.0), n)
+    x[rng.random(n) < null_density] = np.nan
+    y = x * rng.uniform(0.5, 2.0) + rng.normal(0, 1.0, n)
+    cardinality = int(rng.choice([1, 2, 23, 900]))
+    pool = np.array(
+        ["", "x", "-3", "7.5", "true", "a b", "it's", "user@example.com"][
+            : max(1, min(8, cardinality))
+        ]
+        + [f"v{i}" for i in range(max(0, cardinality - 8))],
+        dtype=object,
+    )
+    s = pool[rng.integers(0, len(pool), n)]
+    s[rng.random(n) < null_density] = None
+    g = rng.integers(0, max(1, cardinality), n)
+    return Table.from_pydict(
+        {
+            "x": list(x),
+            "y": list(y),
+            "s": list(s),
+            "g": [int(v) for v in g],
+        },
+        types={
+            "x": ColumnType.DOUBLE,
+            "y": ColumnType.DOUBLE,
+            "s": ColumnType.STRING,
+            "g": ColumnType.LONG,
+        },
+    )
+
+
+def random_check(rng: np.random.Generator) -> Check:
+    """3-9 random DSL constraints. Exact-metric constraints use
+    thresholds drawn continuously (probability ~0 of landing within
+    engine FP jitter of the metric); sketch-backed constraints use
+    far-out bounds so rank-error randomization cannot flip them."""
+    size_t = float(rng.uniform(0, 3000))
+    frac_t = float(rng.uniform(0, 1))
+    stat_t = float(rng.uniform(-120, 120))
+    far = float(rng.choice([-1e15, 1e15]))
+
+    builders = [
+        lambda c: c.has_size(lambda v, t=size_t: v >= t),
+        lambda c: c.has_size(lambda v, t=size_t: v >= t).where("g > 1"),
+        lambda c: c.is_complete("x"),
+        lambda c: c.is_complete("s"),
+        lambda c: c.has_completeness("x", lambda v, t=frac_t: v >= t),
+        lambda c: c.has_completeness(
+            "s", lambda v, t=frac_t: v >= t
+        ).where("g >= 0"),
+        lambda c: c.is_unique("g"),
+        lambda c: c.has_uniqueness(("g",), lambda v, t=frac_t: v >= t),
+        lambda c: c.has_distinctness(("s",), lambda v, t=frac_t: v >= t),
+        lambda c: c.has_unique_value_ratio(
+            ("g",), lambda v, t=frac_t: v >= t
+        ),
+        lambda c: c.has_number_of_distinct_values(
+            "g", lambda v, t=size_t: v <= max(t, 1)
+        ),
+        lambda c: c.has_entropy("g", lambda v, t=frac_t: v >= t),
+        lambda c: c.has_mutual_information(
+            "s", "g", lambda v, t=frac_t: v >= t * 0.1
+        ),
+        lambda c: c.has_min("x", lambda v, t=stat_t: v <= t),
+        lambda c: c.has_max("x", lambda v, t=stat_t: v >= t),
+        lambda c: c.has_mean("x", lambda v, t=stat_t: v >= t),
+        lambda c: c.has_sum("x", lambda v, t=stat_t: v >= t),
+        lambda c: c.has_standard_deviation("x", lambda v, t=frac_t: v >= t),
+        lambda c: c.has_correlation(
+            "x", "y", lambda v, t=frac_t: abs(v) >= t * 0.5
+        ),
+        # sketch-backed: far-out bounds, immune to rank-error jitter
+        lambda c: c.has_approx_quantile(
+            "x", 0.5, lambda v, t=far: (v >= t) if t < 0 else (v <= t)
+        ),
+        lambda c: c.has_approx_count_distinct(
+            "g", lambda v, t=far: (v >= t) if t < 0 else (v <= t)
+        ),
+        lambda c: c.satisfies("x > 0", "pos", lambda v, t=frac_t: v >= t),
+        lambda c: c.has_pattern(
+            "s", r"^v\d+$", lambda v, t=frac_t: v >= t
+        ),
+        lambda c: c.contains_email("s", lambda v, t=frac_t: v <= max(t, 0.5)),
+        lambda c: c.has_data_type(
+            "s",
+            ConstrainableDataTypes.INTEGRAL,
+            lambda v, t=frac_t: v <= max(t, 0.5),
+        ),
+        lambda c: c.is_non_negative("x"),
+        lambda c: c.is_positive("x").where("g >= 1"),
+        lambda c: c.is_less_than("x", "y"),
+        lambda c: c.is_greater_than_or_equal_to("y", "x"),
+        lambda c: c.is_contained_in("s", ["x", "-3", "7.5", "v1"]),
+        lambda c: c.is_contained_in(
+            "g", lower_bound=0.0, upper_bound=1000.0
+        ),
+    ]
+    level = CheckLevel.ERROR if rng.random() < 0.5 else CheckLevel.WARNING
+    check = Check(level, f"fuzz-{rng.integers(1 << 30)}")
+    k = int(rng.integers(3, 10))
+    for i in rng.choice(len(builders), size=k, replace=False):
+        check = builders[int(i)](check)
+    return check
+
+
+def suite_snapshot(result):
+    """Engine-comparable projection of a VerificationResult: overall
+    status, per-check status, per-constraint status, and the metric
+    values keyed by analyzer repr."""
+    checks = []
+    for check, cres in result.check_results.items():
+        checks.append(
+            (
+                check.description,
+                cres.status.name,
+                tuple(
+                    (str(cr.constraint), cr.status.name)
+                    for cr in cres.constraint_results
+                ),
+            )
+        )
+    metrics = {}
+    for analyzer, metric in result.metrics.items():
+        v = metric.value
+        if v.is_failure:
+            metrics[repr(analyzer)] = ("FAIL", type(v.exception).__name__)
+        else:
+            value = v.get()
+            if hasattr(value, "values"):  # Distribution
+                value = tuple(
+                    sorted(
+                        (k, dv.absolute) for k, dv in value.values.items()
+                    )
+                )
+            elif isinstance(value, dict):
+                value = tuple(sorted(value.items()))
+            metrics[repr(analyzer)] = ("OK", value)
+    return result.status.name, tuple(checks), metrics
+
+
+def assert_snapshots_agree(a, b, context: str) -> None:
+    status_a, checks_a, metrics_a = a
+    status_b, checks_b, metrics_b = b
+    assert status_a == status_b, (context, status_a, status_b)
+    assert checks_a == checks_b, (context, checks_a, checks_b)
+    assert metrics_a.keys() == metrics_b.keys(), context
+    for key in metrics_a:
+        sa, va = metrics_a[key]
+        sb, vb = metrics_b[key]
+        assert sa == sb, (context, key, metrics_a[key], metrics_b[key])
+        if sa == "FAIL":
+            assert va == vb, (context, key)
+        elif key.startswith(("ApproxQuantile", "ApproxCountDistinct")):
+            # sketch merge trees differ across engines: rank-error loose
+            if isinstance(va, tuple):
+                assert len(va) == len(vb), (context, key)
+                for (ka, xa), (kb, xb) in zip(va, vb):
+                    assert ka == kb, (context, key)
+                    assert xb == pytest.approx(xa, rel=0.25, abs=2.0), (
+                        context, key,
+                    )
+            else:
+                assert vb == pytest.approx(va, rel=0.25, abs=2.0), (
+                    context, key, va, vb,
+                )
+        elif isinstance(va, float):
+            assert vb == pytest.approx(va, rel=1e-9, abs=1e-12), (
+                context, key, va, vb,
+            )
+        else:
+            assert va == vb, (context, key)
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_suite_agrees_across_engines(seed, monkeypatch):
+    rng = np.random.default_rng(7000 + seed)
+    table = random_table(rng)
+    checks = [random_check(rng) for _ in range(int(rng.integers(1, 3)))]
+
+    def run(engine, mesh=None, placement=None):
+        if placement is None:
+            monkeypatch.delenv("DEEQU_TPU_PLACEMENT", raising=False)
+        else:
+            monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+        builder = VerificationSuite().on_data(table)
+        for check in checks:
+            builder = builder.add_check(check)
+        return suite_snapshot(builder.with_engine(engine, mesh).run())
+
+    host_fold = run("single", placement="host")
+    single_dev = run("single", placement="device")
+    mesh = run("distributed", mesh=data_mesh())
+
+    assert_snapshots_agree(host_fold, single_dev, "host-vs-device")
+    assert_snapshots_agree(host_fold, mesh, "host-vs-mesh")
