@@ -851,17 +851,16 @@ from deequ_tpu.ops.strings import (  # noqa: E402
 
 def _classified_dict(col) -> np.ndarray:
     """int8 class code per dictionary entry, memoized on the ROOT column
-    (one classify pass per table; batches share the whole dictionary —
-    consumed by both the per-row dtclass codes and the counts-based
-    DataType shortcut)."""
-    from deequ_tpu.data.table import cached_column_encode
+    and across stream batches via the dictionary content digest (one
+    classify pass per distinct dictionary — consumed by both the
+    per-row dtclass codes and the counts-based DataType shortcut)."""
+    from deequ_tpu.data.table import cached_dictionary_encode
     from deequ_tpu.ops.strings import classify
 
-    return cached_column_encode(
+    return cached_dictionary_encode(
         col,
         "dtclassdict",
         lambda c: classify(np.asarray(c.dict_encode()[1])).astype(np.int8),
-        slicer=lambda v, start, stop: v,
     )
 
 

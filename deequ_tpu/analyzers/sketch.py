@@ -112,14 +112,16 @@ def _hll_spec(column: str) -> InputSpec:
         from deequ_tpu.data.table import ColumnType
 
         if col.ctype == ColumnType.STRING:
-            # share the batch's dict-encode; hash unique strings only;
-            # null rows map to packed code 0 (idx 0, rank 0 — a no-op
-            # for the scatter-max)
-            from deequ_tpu.data.table import gather_with_null
-            from deequ_tpu.ops.strings import hash_strings
+            # share the batch's dict-encode; hash unique strings only
+            # (cross-batch dictionary memo); null rows map to packed
+            # code 0 (idx 0, rank 0 — a no-op for the scatter-max)
+            from deequ_tpu.data.table import (
+                gather_with_null,
+                hashed_dictionary,
+            )
 
-            codes, uniques = col.dict_encode()
-            idx_u, rank_u = hll.registers_from_hashes(hash_strings(uniques))
+            codes, _uniques = col.dict_encode()
+            idx_u, rank_u = hll.registers_from_hashes(hashed_dictionary(col))
             return gather_with_null(
                 ((idx_u << 6) | rank_u).astype(np.int32), codes, 0
             )
@@ -193,9 +195,29 @@ class ApproxCountDistinct(ScanShareableAnalyzer):
                     from deequ_tpu.ops.strings import hash_strings
 
                     present, uniques = pres
-                    hashes = hash_strings(
-                        np.asarray(uniques, dtype=object)[np.asarray(present)]
-                    )
+                    present = np.asarray(present)
+                    # hash the FULL dictionary through the cross-batch
+                    # memo when reachable (stream batches rebuild equal
+                    # dictionaries), then select the present entries
+                    hashes = None
+                    batch = getattr(inputs, "batch", None)
+                    if batch is not None:
+                        try:
+                            from deequ_tpu.data.table import (
+                                hashed_dictionary,
+                            )
+
+                            full = hashed_dictionary(
+                                batch.column(self.column)
+                            )
+                            if len(full) == len(present):
+                                hashes = full[present]
+                        except Exception:  # noqa: BLE001 - direct hash
+                            hashes = None
+                    if hashes is None:
+                        hashes = hash_strings(
+                            np.asarray(uniques, dtype=object)[present]
+                        )
                     idx, rank = hll.registers_from_hashes(hashes)
                     registers = np.zeros(hll.M, dtype=np.int32)
                     np.maximum.at(registers, idx, rank.astype(np.int32))

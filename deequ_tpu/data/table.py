@@ -53,6 +53,12 @@ class Column:
     strings.
     """
 
+    # content digest of the backing arrow dictionary (set by from_arrow
+    # for parquet dictionary columns): lets dictionary-LEVEL derived
+    # values (classify/parse/hash of the dict itself) be shared across
+    # STREAM batches, whose equal dictionaries are rebuilt per row group
+    _dict_content_key = None
+
     def __init__(self, name: str, ctype: ColumnType, values, valid: np.ndarray):
         self.name = name
         self.ctype = ctype
@@ -130,10 +136,8 @@ class Column:
                     col.valid,
                 )
             if col.ctype == ColumnType.STRING:
-                from deequ_tpu.ops.strings import parse_floats
-
-                codes, uniques = col.dict_encode()
-                u_vals, u_ok = parse_floats(uniques)
+                codes, _uniques = col.dict_encode()
+                u_vals, u_ok = parsed_dictionary(col)
                 return (
                     gather_with_null(u_vals, codes, 0.0),
                     gather_with_null(u_ok, codes, False),
@@ -251,6 +255,114 @@ def cached_column_encode(col: "Column", key: str, compute, slicer=None):
             cached = compute(col)
         col._cache[key] = cached
     return cached
+
+
+_DICT_DERIVED_CACHE: "OrderedDict" = None  # type: ignore[assignment]
+_DICT_DERIVED_MAX = 256
+# byte budget: a stream whose every row group carries a DISTINCT
+# near-64k-entry dictionary must not pin hundreds of MB of derived
+# arrays for the process lifetime (the bounded-RSS stream contract)
+_DICT_DERIVED_MAX_BYTES = 32 << 20
+_DICT_DERIVED_BYTES = 0
+
+
+def _derived_nbytes(value) -> int:
+    if isinstance(value, np.ndarray):
+        return int(value.nbytes)
+    if isinstance(value, (tuple, list)):
+        return sum(_derived_nbytes(v) for v in value)
+    return 64  # scalars / small objects: nominal
+
+
+def cached_dictionary_encode(col: "Column", key: str, compute):
+    """DICTIONARY-level derived value (classify / numeric parse / hash of
+    the dictionary itself — NOT row data): memoized on the root Column
+    like `cached_column_encode`, and additionally across BATCHES via the
+    arrow dictionary's content digest when available. A streamed parquet
+    source rebuilds an equal dictionary for every row group; without
+    this memo every batch re-classifies/re-parses/re-hashes the same few
+    thousand strings. The cross-batch tier is bounded by entry count AND
+    bytes (LRU eviction)."""
+    global _DICT_DERIVED_CACHE, _DICT_DERIVED_BYTES
+    root = col
+    while getattr(root, "_parent", None) is not None:
+        root = root._parent[0]
+    cached = root._cache.get(key)
+    if cached is not None:
+        return cached
+    content_key = root._dict_content_key
+    if content_key is not None:
+        if _DICT_DERIVED_CACHE is None:
+            from collections import OrderedDict
+
+            _DICT_DERIVED_CACHE = OrderedDict()
+        hit = _DICT_DERIVED_CACHE.get((content_key, key))
+        if hit is not None:
+            _DICT_DERIVED_CACHE.move_to_end((content_key, key))
+            root._cache[key] = hit[0]
+            return hit[0]
+    value = compute(root)
+    root._cache[key] = value
+    if content_key is not None:
+        nbytes = _derived_nbytes(value)
+        _DICT_DERIVED_CACHE[(content_key, key)] = (value, nbytes)
+        _DICT_DERIVED_BYTES += nbytes
+        while _DICT_DERIVED_CACHE and (
+            len(_DICT_DERIVED_CACHE) > _DICT_DERIVED_MAX
+            or _DICT_DERIVED_BYTES > _DICT_DERIVED_MAX_BYTES
+        ):
+            _key, (_value, evicted_bytes) = _DICT_DERIVED_CACHE.popitem(
+                last=False
+            )
+            _DICT_DERIVED_BYTES -= evicted_bytes
+    return value
+
+
+def _arrow_dictionary_digest(dictionary):
+    """Content digest of an arrow string dictionary (the cross-batch
+    memo key): sha1 over its raw buffers, ~µs for the few-thousand-entry
+    dictionaries parquet produces. None (no sharing) for offset/sliced
+    or oversized dictionaries, where buffer bytes would not equal
+    content."""
+    try:
+        if dictionary.offset != 0 or len(dictionary) > (1 << 16):
+            return None
+        import hashlib
+
+        h = hashlib.sha1()
+        for buf in dictionary.buffers():
+            if buf is not None:
+                h.update(buf)
+        return (len(dictionary), h.digest())
+    except Exception:  # noqa: BLE001 - memo is an optimization only
+        return None
+
+
+def parsed_dictionary(col: "Column"):
+    """(parsed float64 values, parse-ok bool) per dictionary entry of a
+    STRING column, through the cross-batch dictionary memo — shared by
+    numeric_values' per-row gather and the profiler's counts-based
+    numeric-stats path."""
+    from deequ_tpu.ops.strings import parse_floats
+
+    return cached_dictionary_encode(
+        col,
+        "dictparse",
+        lambda c: parse_floats(np.asarray(c.dict_encode()[1], dtype=object)),
+    )
+
+
+def hashed_dictionary(col: "Column") -> np.ndarray:
+    """uint64 xxhash per dictionary entry of a STRING column, through
+    the cross-batch dictionary memo — shared by the packed-HLL input
+    spec and the _LowCardCounts presence path of ApproxCountDistinct."""
+    from deequ_tpu.ops.strings import hash_strings
+
+    return cached_dictionary_encode(
+        col,
+        "dicthash",
+        lambda c: hash_strings(np.asarray(c.dict_encode()[1], dtype=object)),
+    )
 
 
 def gather_with_null(lut: np.ndarray, codes: np.ndarray, null_value) -> np.ndarray:
@@ -522,6 +634,9 @@ class Table:
                     valid,
                 )
                 col._cache["dict_encode"] = (codes, uniques)
+                col._dict_content_key = _arrow_dictionary_digest(
+                    arr.dictionary
+                )
                 cols.append(col)
             elif pa.types.is_string(t) or pa.types.is_large_string(t):
                 vals = arr.to_numpy(zero_copy_only=False)

@@ -207,15 +207,17 @@ def run_multihost_analysis(
     (the distributed form of runOnAggregatedStates,
     reference: examples/UpdateMetricsOnPartitionedDataExample.scala:30-95).
 
-    `save_states_with` optionally receives a COPY of the LOCAL
-    (pre-merge) states — callers that want to inspect or persist this
-    host's partition contribution (e.g. the dryrun asserting a spilled
+    `save_states_with` optionally receives this host's LOCAL
+    (pre-merge) states — callers that want to inspect or persist the
+    partition contribution (e.g. the dryrun asserting a spilled
     frequency state) get them from the single analysis pass instead of
-    recomputing. The merge itself always reads a FRESH internal
-    provider, so a reused/pre-populated caller provider can never leak
-    a previous run's state into this host's contribution (an empty
-    local state is never persisted, so it would not overwrite a stale
-    entry).
+    recomputing. The persisted values are the SAME state objects the
+    cross-host merge then serializes, so the receiving persister must
+    treat them as read-only. The merge itself always reads a FRESH
+    internal provider, so a reused/pre-populated caller provider can
+    never leak a previous run's state into this host's contribution (an
+    empty local state is never persisted, so it would not overwrite a
+    stale entry).
 
     A failure on ANY host fails that analyzer's global metric on EVERY
     host — a partition that errored must not silently drop out of a

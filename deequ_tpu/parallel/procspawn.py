@@ -123,5 +123,12 @@ def run_worker_processes(
                     details,
                     runtime_unavailable=False,
                 )
-            results.append(json.loads(lines[-1][len("RESULT:"):]))
+            try:
+                results.append(json.loads(lines[-1][len("RESULT:"):]))
+            except ValueError as e:
+                raise WorkerFailure(
+                    f"rank {rank} produced a malformed RESULT line: {e}",
+                    details,
+                    runtime_unavailable=False,
+                )
         return results

@@ -376,22 +376,12 @@ class _OptimisticNumericStats(ScanShareableAnalyzer):
         if len(cs_all) != len(uniques):
             return None
 
-        def parse_dict(col):
-            from deequ_tpu.ops.strings import parse_floats
-
-            return parse_floats(np.asarray(col.dict_encode()[1], dtype=object))
-
         batch = getattr(inputs, "batch", None)
         try:
             if batch is not None:
-                from deequ_tpu.data.table import cached_column_encode
+                from deequ_tpu.data.table import parsed_dictionary
 
-                u_vals, u_ok = cached_column_encode(
-                    batch.column(self.column),
-                    "optnumdict",
-                    parse_dict,
-                    slicer=lambda v, start, stop: v,
-                )
+                u_vals, u_ok = parsed_dictionary(batch.column(self.column))
             else:
                 from deequ_tpu.ops.strings import parse_floats
 

@@ -171,6 +171,64 @@ class TestCountsFamilyParity:
         assert not regs.any()
 
 
+class TestDictionaryContentMemo:
+    def test_cross_batch_hits_and_content_safety(self, tmp_path):
+        """Streamed batches with EQUAL dictionaries share one derived
+        classify/parse/hash; different dictionary content never hits the
+        memo; streamed profile equals the in-memory profile either way."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from deequ_tpu.data import table as table_mod
+        from deequ_tpu.data.table import Table, parsed_dictionary
+
+        # same dictionary in both row groups
+        values = ["10", "20", "30", "40"] * 500
+        at = pa.table({"s": pa.array(values).dictionary_encode()})
+        path = str(tmp_path / "memo.parquet")
+        pq.write_table(at, path, row_group_size=1000)
+
+        calls = {"n": 0}
+        original = table_mod.cached_dictionary_encode
+
+        def counting(col, key, compute):
+            def compute_counted(c):
+                calls["n"] += 1
+                return compute(c)
+
+            return original(col, key, compute_counted)
+
+        src = Table.scan_parquet(path, batch_rows=1000)
+        batches = list(src.batches(1000))
+        assert len(batches) >= 2
+        cols = [b.column("s") for b in batches]
+        assert cols[0]._dict_content_key is not None
+        assert cols[0]._dict_content_key == cols[1]._dict_content_key
+        import unittest.mock as mock
+
+        with mock.patch.object(
+            table_mod, "cached_dictionary_encode", counting
+        ):
+            a = parsed_dictionary(cols[0])
+            b = parsed_dictionary(cols[1])
+        assert calls["n"] == 1, "second batch must hit the cross-batch memo"
+        assert np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+
+        # different content -> different key (no false sharing)
+        at2 = pa.table(
+            {"s": pa.array(["99", "88", "77", "66"] * 250).dictionary_encode()}
+        )
+        path2 = str(tmp_path / "memo2.parquet")
+        pq.write_table(at2, path2)
+        col2 = next(iter(Table.scan_parquet(path2).batches(10_000))).column(
+            "s"
+        )
+        assert col2._dict_content_key != cols[0]._dict_content_key
+        v2, ok2 = parsed_dictionary(col2)
+        assert sorted(v2.tolist()) == [66.0, 77.0, 88.0, 99.0]
+        assert ok2.all()
+
+
 class TestDataTypeFromCounts:
     def _datatype_agg(self, table, monkeypatch=None, disable=False):
         from deequ_tpu.runners import AnalysisRunner
