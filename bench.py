@@ -396,7 +396,6 @@ def _measure_baseline_subprocess(mode: str = "profiler") -> float:
 def write_parquet(n_rows: int, path: str, chunk: int = 2_000_000) -> None:
     """Stream-generate the bench table to disk in chunks (bounded memory),
     so stream mode can exceed host RAM."""
-    import pyarrow as pa
     import pyarrow.parquet as pq
 
     writer = None
@@ -404,17 +403,7 @@ def write_parquet(n_rows: int, path: str, chunk: int = 2_000_000) -> None:
     seed = 0
     while done < n_rows:
         rows = min(chunk, n_rows - done)
-        t = build_table(rows, seed=seed)
-        data = {}
-        for name, _ in t.schema:
-            col = t.column(name)
-            if col.values.dtype == object:
-                data[name] = pa.array(
-                    [v if ok else None for v, ok in zip(col.values, col.valid)]
-                )
-            else:
-                data[name] = pa.array(col.values, mask=~col.valid)
-        at = pa.table(data)
+        at = build_table(rows, seed=seed).to_arrow()
         if writer is None:
             writer = pq.ParquetWriter(path, at.schema)
         writer.write_table(at)

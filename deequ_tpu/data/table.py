@@ -657,6 +657,39 @@ class Table:
                 cols.append(Column(name, ColumnType.STRING, vals, valid))
         return Table(cols)
 
+    def to_arrow(self, dictionary_encode_strings: bool = False):
+        """Arrow table with faithful nulls: the Column neutral-fill
+        contract is inverted (null slots become arrow nulls, not the
+        0.0/""/False fillers). The single conversion used by every
+        write-to-parquet path (tests, dryruns, bench)."""
+        import pyarrow as pa
+
+        data = {}
+        for name, _ctype in self.schema:
+            col = self.column(name)
+            values = col.values
+            valid = np.asarray(col.valid)
+            if values.dtype == object:
+                arr = pa.array(
+                    [v if ok else None for v, ok in zip(values, valid)]
+                )
+                if dictionary_encode_strings:
+                    arr = arr.dictionary_encode()
+            else:
+                arr = pa.array(values, mask=~valid)
+            data[name] = arr
+        return pa.table(data)
+
+    def to_parquet(self, path: str, row_group_size: Optional[int] = None,
+                   dictionary_encode_strings: bool = False) -> None:
+        import pyarrow.parquet as pq
+
+        pq.write_table(
+            self.to_arrow(dictionary_encode_strings),
+            path,
+            row_group_size=row_group_size,
+        )
+
     @staticmethod
     def from_parquet(path: str, columns: Optional[List[str]] = None) -> "Table":
         import pyarrow.parquet as pq

@@ -229,3 +229,37 @@ def test_suite_agrees_across_engines(seed, monkeypatch):
 
     assert_snapshots_agree(host_fold, single_dev, "host-vs-device")
     assert_snapshots_agree(host_fold, mesh, "host-vs-mesh")
+
+
+@pytest.mark.parametrize("seed", range(0, N_SEEDS, 4))
+def test_suite_agrees_streamed_vs_in_memory(seed, monkeypatch, tmp_path):
+    """The STREAMED engine dimension: the same random table written to
+    Parquet with tiny row groups (many batches — the counts fast paths,
+    dictionary memos and per-batch folds all cross batch boundaries)
+    must produce the same VerificationSuite outcome as the in-memory
+    host fold."""
+    from deequ_tpu.data.table import Table as TableCls
+
+    rng = np.random.default_rng(9000 + seed)
+    table = random_table(rng)
+    checks = [random_check(rng) for _ in range(int(rng.integers(1, 3)))]
+
+    path = str(tmp_path / "fuzz.parquet")
+    table.to_parquet(
+        path,
+        row_group_size=max(64, len(table.column("x")) // 7),
+        dictionary_encode_strings=True,
+    )
+
+    def run(data):
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "host")
+        builder = VerificationSuite().on_data(data)
+        for check in checks:
+            builder = builder.add_check(check)
+        return suite_snapshot(builder.with_engine("single").run())
+
+    in_memory = run(table)
+    streamed = run(
+        TableCls.scan_parquet(path, batch_rows=max(64, len(table.column("x")) // 5))
+    )
+    assert_snapshots_agree(in_memory, streamed, "memory-vs-stream")
