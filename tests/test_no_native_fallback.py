@@ -1,0 +1,122 @@
+"""The numpy fallbacks must produce the same results as the C kernels.
+
+Every native entry point returns None when the library is unavailable
+and callers fall back to numpy (`ops/native/__init__.py` docstring
+promises identical results) — but nothing exercised that configuration
+end-to-end. These tests simulate an image without a C compiler by
+pinning the loader to "unavailable" and compare whole-profile and
+analyzer outputs against the native run.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import pytest
+
+from deequ_tpu.ops import native
+
+
+@pytest.fixture
+def no_native(monkeypatch):
+    """Simulate `cc` missing: the loader reports unavailable for the
+    rest of the test (module globals restored by monkeypatch)."""
+    monkeypatch.setattr(native, "_TRIED", True)
+    monkeypatch.setattr(native, "_LIB", None)
+    assert not native.available()
+
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native kernels unavailable anyway"
+)
+
+
+@needs_native
+def test_profile_identical_without_native(no_native, monkeypatch):
+    # order matters: the FALLBACK profile runs first under the fixture's
+    # no-native pins, then the pins are overwritten (not restored) so
+    # the reference profile runs with the real C kernels
+    from deequ_tpu.analyzers import sketch as sketch_mod
+    from deequ_tpu.data.table import Table
+    from deequ_tpu.profiles.column_profiler import ColumnProfiler
+
+    rng = np.random.default_rng(21)
+    n = 40_000
+    price = rng.lognormal(1.0, 0.5, n)
+    price[rng.random(n) < 0.05] = np.nan
+    qty = rng.integers(1, 60, n).astype(np.int64)
+    code = np.array([str(v) for v in rng.integers(0, 400, n)], dtype=object)
+    cat = np.array(["a", "b", "c"], dtype=object)[rng.integers(0, 3, n)]
+
+    def build():
+        return Table.from_numpy(
+            {
+                "qty": qty.copy(),
+                "price": price.copy(),
+                "code": code.copy(),
+                "cat": cat.copy(),
+            }
+        )
+
+    monkeypatch.setattr(
+        sketch_mod, "_BATCH_SEED_COUNTER", itertools.count(1)
+    )
+    fallback = ColumnProfiler.profile(build()).profiles
+
+    # undo the fixture's pins for the reference run
+    monkeypatch.setattr(native, "_TRIED", False)
+    monkeypatch.setattr(native, "_LIB", None)
+    assert native.available()
+    monkeypatch.setattr(
+        sketch_mod, "_BATCH_SEED_COUNTER", itertools.count(1)
+    )
+    with_native = ColumnProfiler.profile(build()).profiles
+
+    assert fallback.keys() == with_native.keys()
+    for name in fallback:
+        f, w = fallback[name], with_native[name]
+        assert f.completeness == w.completeness, name
+        assert f.data_type == w.data_type, name
+        assert f.type_counts == w.type_counts, name
+        assert f.approximate_num_distinct_values == (
+            w.approximate_num_distinct_values
+        ), name
+        if getattr(f, "mean", None) is not None:
+            assert f.mean == pytest.approx(w.mean, rel=1e-12), name
+            assert f.minimum == w.minimum and f.maximum == w.maximum, name
+            assert f.std_dev == pytest.approx(w.std_dev, rel=1e-9), name
+            for fv, wv in zip(
+                f.approx_percentiles or [], w.approx_percentiles or []
+            ):
+                assert fv == pytest.approx(wv, rel=1e-9, abs=1e-12), name
+        hf, hw = f.histogram, w.histogram
+        assert (hf is None) == (hw is None), name
+        if hf is not None:
+            assert hf.values == hw.values, name
+
+
+@needs_native
+def test_kernel_wrappers_return_none_without_native(no_native):
+    ones = np.ones(128, dtype=bool)
+    assert native.xxhash64_pack(np.arange(128, dtype=np.int64), ones) is None
+    assert native.masked_moments(np.ones(128), ones, None) is None
+    assert native.bincount(np.zeros(128, dtype=np.int64), 4) is None
+    assert (
+        native.bincount_window(
+            np.zeros(128, dtype=np.int64), None, None, 0, 16
+        )
+        is None
+    )
+    assert (
+        native.masked_moments_select(np.ones(128), ones, None, 16) is None
+    )
+    from deequ_tpu.ops import counts_family
+
+    # the counts fast path degrades to None (select fallback), never raises
+    assert (
+        counts_family.counts_for_column(
+            np.arange(128, dtype=np.int64), None, None
+        )
+        is None
+    )
