@@ -578,15 +578,23 @@ if __name__ == "__main__":
     if "--measure-baseline" in sys.argv:
         probe_mode = os.environ.get("BENCH_MODE", "profiler")
         probe_rows = 2_000_000 if probe_mode not in ("wide",) else 500_000
-        pandas_rate = measure_reference_profile_rows_per_sec(
-            probe_rows, mode=probe_mode
+        # best-of-3: the engine side is best-of-N timed reps, so the
+        # baseline gets its best box phase too — a single-shot probe on
+        # a drifting shared vCPU would randomly deflate the denominator
+        # and inflate the ratio
+        pandas_rate = max(
+            measure_reference_profile_rows_per_sec(probe_rows, mode=probe_mode)
+            for _ in range(3)
         )
         arrow_rate = 0.0
         if probe_mode not in ("wide", "lineitem"):
-            try:
-                arrow_rate = measure_arrow_profile_rows_per_sec()
-            except Exception:  # noqa: BLE001 - acero probe is best-effort
-                arrow_rate = 0.0
+            for _ in range(3):
+                try:
+                    arrow_rate = max(
+                        arrow_rate, measure_arrow_profile_rows_per_sec()
+                    )
+                except Exception:  # noqa: BLE001 - acero is best-effort
+                    pass  # keep any reps that already succeeded
         print(
             f"# pandas {pandas_rate / 1e6:.2f}M rows/s, "
             f"pyarrow-acero(1 thread) {arrow_rate / 1e6:.2f}M rows/s",
