@@ -132,8 +132,14 @@ def weighted_moments_and_sample(
     else:
         sum_d = float(np.dot(cs.astype(np.longdouble), vs))
     avg = sum_d / m
-    d = vs - avg
-    m2 = float(np.dot(cs.astype(np.longdouble), (d * d).astype(np.longdouble)))
+    with np.errstate(over="ignore"):
+        # d*d squares in float64 on purpose: the C kernel's `double d`
+        # overflows to inf at the same magnitudes, and parity means
+        # matching that (inf == inf), not avoiding it
+        d = vs - avg
+        m2 = float(
+            np.dot(cs.astype(np.longdouble), (d * d).astype(np.longdouble))
+        )
     level = 0
     while (cap << level) < m:
         level += 1
@@ -147,6 +153,82 @@ def weighted_moments_and_sample(
     else:
         sample = np.zeros(0, dtype=np.float64)
     return (float(m), sum_d, float(vs[0]), float(vs[-1]), m2), sample, m, level
+
+
+_SIGN = np.uint64(1) << np.uint64(63)
+
+
+def hash_counts_for_column(
+    values: np.ndarray,
+    valid: Optional[np.ndarray],
+    where: Optional[np.ndarray],
+):
+    """(distinct_keys_u64, counts, n_valid, n_where) via the
+    open-addressing C counter, for float64 (keys = bit patterns) or
+    int64 (keys = values) columns; None when native is unavailable or
+    the column exceeds 65536 distinct values (the kernel aborts after a
+    prefix). Extends the counts fast path to low-cardinality FLOAT
+    columns (discount/tax/rate-style) and sparse wide-range integers
+    the dense window cannot hold."""
+    from deequ_tpu.ops import native
+
+    if values.dtype not in (np.float64, np.int64) or len(values) == 0:
+        return None
+    return native.hashcount(values.view(np.uint64), valid, where)
+
+
+def family_from_hash_counts(
+    keys_u64: np.ndarray,
+    counts: np.ndarray,
+    kind: str,
+    cap: int,
+    n_where: int,
+    want_regs: bool,
+):
+    """Derive the select kernel's output tuple from hash-table distinct
+    counts. `kind` is 'f64' (keys are bit patterns; sort order is the C
+    kernel's f64_key total order, so -0.0 sorts before +0.0 exactly like
+    the radix select) or 'i64' (keys are values)."""
+    keys_u64 = np.asarray(keys_u64, dtype=np.uint64)
+    counts = np.asarray(counts)
+    exact_sum = None
+    if kind == "f64":
+        order = np.argsort(np.where(keys_u64 >> np.uint64(63), ~keys_u64,
+                                    keys_u64 | _SIGN))
+        vs = keys_u64[order].view(np.float64)
+        cs = counts[order]
+    else:
+        ints = keys_u64.view(np.int64)
+        order = np.argsort(ints)
+        ints = ints[order]
+        vs = ints.astype(np.float64)
+        cs = counts[order]
+        if len(ints):
+            amax = max(abs(int(ints[0])), abs(int(ints[-1])))
+            if amax < (1 << 31):
+                exact_sum = int(np.dot(cs, ints))
+            else:
+                exact_sum = sum(
+                    int(c) * int(v) for c, v in zip(cs, ints)
+                )
+    core, sample, m, level = weighted_moments_and_sample(
+        vs, cs, cap, exact_sum=exact_sum
+    )
+    mom = np.array(list(core) + [float(n_where)], dtype=np.float64)
+    regs = None
+    if want_regs:
+        from deequ_tpu.ops.sketches import hll
+
+        regs = np.zeros(hll.M, dtype=np.int32)
+        if len(keys_u64):
+            packed = hll.pack_codes(
+                keys_u64.view(np.int64),
+                np.ones(len(keys_u64), dtype=bool),
+            )
+            np.maximum.at(
+                regs, packed >> 6, (packed & 0x3F).astype(np.int32)
+            )
+    return mom, sample, m, level, regs
 
 
 def family_from_counts(

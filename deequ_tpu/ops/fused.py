@@ -487,10 +487,11 @@ def _counts_family_shortcut(
         col = batch.column(column)
     except Exception:  # noqa: BLE001 - missing column: let the member fail
         return False
-    if col.ctype != ColumnType.LONG:
+    if col.ctype not in (ColumnType.LONG, ColumnType.DOUBLE):
         return False
     values = np.asarray(col.values)
-    if values.dtype != np.int64:
+    is_long = col.ctype == ColumnType.LONG
+    if values.dtype != (np.int64 if is_long else np.float64):
         return False
     try:
         valid = np.asarray(built[f"valid:{column}"])
@@ -503,15 +504,26 @@ def _counts_family_shortcut(
         warr.dtype != np.bool_ or len(warr) != len(values)
     ):
         return False
-    res = counts_family.counts_for_column(values, valid, warr)
-    if res is None:
-        return False
-    counts, lo, _n_valid, n_where = res
-    if warr is None:
-        n_where = len(values)
-    mom, sample, n_valid, level, regs = counts_family.family_from_counts(
-        counts, lo, cap, n_where, want_regs
-    )
+    derived = None
+    if is_long:
+        # dense window first (cheapest); sparse wide-range ints fall
+        # through to the hash counter
+        res = counts_family.counts_for_column(values, valid, warr)
+        if res is not None:
+            counts, lo, _n_valid, n_where = res
+            derived = counts_family.family_from_counts(
+                counts, lo, cap, n_where, want_regs
+            )
+    if derived is None:
+        hres = counts_family.hash_counts_for_column(values, valid, warr)
+        if hres is None:
+            return False
+        keys, counts, _n_valid, n_where = hres
+        derived = counts_family.family_from_hash_counts(
+            keys, counts, "i64" if is_long else "f64", cap, n_where,
+            want_regs,
+        )
+    mom, sample, n_valid, level, regs = derived
     built[qkey] = {
         "sample": sample,
         "n": np.asarray([n_valid], dtype=np.float64),

@@ -141,6 +141,19 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_int64),
             ]
             fn.restype = None
+        lib.hashcount_u64.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.hashcount_u64.restype = ctypes.c_int64
         lib.bincount_window_i64.argtypes = [
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_uint8),
@@ -244,6 +257,64 @@ def masked_moments(
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
     )
     return out
+
+
+_HASHCOUNT_LOG2 = 17  # 131072 slots: load factor <= 0.5 at 65536 distinct
+_HASHCOUNT_MAX_DISTINCT = 1 << 16
+
+
+def hashcount(
+    keys_u64: np.ndarray,
+    valid: Optional[np.ndarray],
+    where: Optional[np.ndarray],
+    max_distinct: int = _HASHCOUNT_MAX_DISTINCT,
+):
+    """Distinct-value counts over raw 8-byte keys (float64 bit patterns
+    or int64 values) in one open-addressing pass:
+    (distinct_keys_u64, counts, n_valid, n_where), or None when native
+    is unavailable OR the column exceeds max_distinct (the kernel aborts
+    after scanning roughly enough rows to see that many distinct values;
+    a skew guard additionally bails at 4*max_distinct scanned rows when
+    the table is already 3/4 full, so heavy-tailed near-cap columns cost
+    only a bounded prefix too)."""
+    lib = _load()
+    if lib is None:
+        return None
+    keys_u64 = np.ascontiguousarray(keys_u64)
+    if keys_u64.dtype != np.uint64:
+        keys_u64 = keys_u64.view(np.uint64)
+    valid = _u8_ptr(valid)
+    where = _u8_ptr(where)
+    slots = 1 << _HASHCOUNT_LOG2
+    table_keys = np.zeros(slots, dtype=np.uint64)
+    table_counts = np.zeros(slots, dtype=np.int64)
+    meta = np.zeros(2, dtype=np.int64)
+    cap = int(min(max_distinct, _HASHCOUNT_MAX_DISTINCT))
+    distinct = lib.hashcount_u64(
+        keys_u64.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if valid is not None
+        else None,
+        where.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if where is not None
+        else None,
+        len(keys_u64),
+        _HASHCOUNT_LOG2,
+        cap,
+        4 * cap,
+        table_keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        table_counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        meta.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if distinct < 0:
+        return None
+    occupied = table_counts > 0
+    return (
+        table_keys[occupied],
+        table_counts[occupied],
+        int(meta[0]),
+        int(meta[1]),
+    )
 
 
 def bincount_window(

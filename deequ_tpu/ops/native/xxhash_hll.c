@@ -153,6 +153,66 @@ void bincount_window_i64(const int64_t *v, const uint8_t *valid,
     if (where) meta[1] = n_where;
 }
 
+/* Open-addressing distinct-value counter over raw 8-byte keys (float64
+ * bit patterns or int64 values — the same canonical identity HLL
+ * hashes). counts[slot]==0 marks an empty slot, so keys[] needs no
+ * sentinel and ANY bit pattern (including +0.0 == all-zero bits) is a
+ * valid key. Returns the number of distinct keys, or -1 the moment the
+ * table would exceed max_distinct — a high-cardinality column aborts
+ * after seeing ~max_distinct distinct values (typically a small prefix
+ * of the data), so speculatively probing every column is cheap. The
+ * caller allocates keys[1<<cap2_log] / counts[1<<cap2_log] zeroed;
+ * choose 1<<cap2_log >= 2*max_distinct so the load factor stays <= 0.5.
+ * A skew guard bounds the worst case (a column whose distinct count
+ * sits just above the cap with the tail appearing late, e.g. Zipf):
+ * once probe_rows rows are scanned, a table already 3/4 full aborts —
+ * heavy-tailed near-cap columns bail after a bounded prefix instead of
+ * scanning almost everything before the inevitable overflow. Columns
+ * rejected by the guard merely fall back to the select kernel.
+ * On success the counts table answers the whole numeric family in
+ * O(#distinct) (ops/counts_family.py) — this extends the windowed
+ * integer fast path to LOW-CARDINALITY FLOAT columns (discount/tax/
+ * rate-style data) and sparse wide-range integers. */
+int64_t hashcount_u64(const uint64_t *x, const uint8_t *valid,
+                      const uint8_t *where, int64_t n, int64_t cap2_log,
+                      int64_t max_distinct, int64_t probe_rows,
+                      uint64_t *keys, int64_t *counts, int64_t *meta) {
+    uint64_t mask = ((uint64_t)1 << cap2_log) - 1;
+    int64_t distinct = 0, count = 0, n_where = 0;
+    int64_t guard_distinct = max_distinct - (max_distinct >> 2);
+    meta[0] = 0;
+    meta[1] = where ? 0 : n;
+    for (int64_t i = 0; i < n; i++) {
+        if (probe_rows > 0 && i == probe_rows && distinct >= guard_distinct)
+            return -1;
+        if (where) {
+            if (!where[i]) continue;
+            n_where++;
+        }
+        if (valid && !valid[i]) continue;
+        uint64_t k = x[i];
+        uint64_t h = xxhash64_u64(k) & mask;
+        for (;;) {
+            if (counts[h] == 0) {
+                if (distinct >= max_distinct) return -1;
+                distinct++;
+                keys[h] = k;
+                counts[h] = 1;
+                break;
+            }
+            if (keys[h] == k) {
+                counts[h]++;
+                break;
+            }
+            h = (h + 1) & mask;
+        }
+        count++;
+    }
+    meta[0] = count;
+    if (where) meta[1] = n_where;
+    return distinct;
+}
+
 /* Fused masked numeric moments: one data traversal feeds Mean, Sum,
  * Minimum, Maximum, StandardDeviation and the count of a whole
  * (column, where) family — the reductions the reference pushes into one

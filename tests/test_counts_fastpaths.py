@@ -133,6 +133,99 @@ class TestCountsFamilyParity:
             is None
         )
 
+    @pytest.mark.parametrize(
+        "case",
+        ["discount", "tax_nulls", "neg_zero", "extreme_floats",
+         "sparse_int", "where_float"],
+    )
+    def test_hash_counts_match_select_kernel(self, case):
+        """The open-addressing hash counter extends the fast path to
+        low-cardinality FLOATS and sparse wide-range integers: outputs
+        must match the select kernel exactly (samples via the f64_key
+        total order — -0.0 before +0.0 — registers via the bit-pattern
+        identity)."""
+        rng = np.random.default_rng(
+            {"discount": 31, "tax_nulls": 32, "neg_zero": 33,
+             "extreme_floats": 34, "sparse_int": 35, "where_float": 36}[case]
+        )
+        n = 150_000
+        valid = where = None
+        if case == "discount":
+            vals = rng.integers(0, 11, n) / 100.0
+        elif case == "tax_nulls":
+            vals = rng.integers(0, 9, n) / 100.0
+            valid = rng.random(n) > 0.15
+        elif case == "neg_zero":
+            vals = np.where(rng.random(n) > 0.5, 0.0, -0.0)
+        elif case == "extreme_floats":
+            vals = rng.choice(
+                [1.5, -2.25, 1e300, -1e-300, 0.125, np.finfo(float).tiny], n
+            )
+        elif case == "sparse_int":
+            vals = (rng.integers(0, 4000, n) * 982451653).astype(np.int64)
+        else:  # where_float
+            vals = rng.integers(0, 4, n) / 4.0
+            valid = rng.random(n) > 0.05
+            where = rng.random(n) > 0.5
+        is_int = np.issubdtype(vals.dtype, np.integer)
+        vals = vals.astype(np.int64 if is_int else np.float64)
+        cap = 460
+        hres = counts_family.hash_counts_for_column(vals, valid, where)
+        assert hres is not None, case
+        keys, counts, _n_valid, n_where = hres
+        mom_c, sample_c, n_c, lvl_c, regs_c = (
+            counts_family.family_from_hash_counts(
+                keys, counts, "i64" if is_int else "f64", cap, n_where,
+                want_regs=True,
+            )
+        )
+        if is_int:
+            ref = _select_reference(vals, valid, where, cap, with_hll=True)
+        else:
+            ref = native.masked_moments_select(
+                vals, valid, where, cap, hll_mode=1
+            )
+        mom_r, sample_r, n_r, lvl_r, regs_r = ref
+        assert (n_c, lvl_c) == (n_r, lvl_r), case
+        assert np.array_equal(sample_c, sample_r), case
+        assert np.array_equal(regs_c, regs_r), case
+        assert mom_c[0] == mom_r[0], case
+        assert mom_c[2] == mom_r[2] and mom_c[3] == mom_r[3], case
+        assert mom_c[5] == mom_r[5], case
+        assert mom_c[1] == pytest.approx(mom_r[1], rel=1e-12, abs=1e-12)
+        assert mom_c[4] == pytest.approx(mom_r[4], rel=1e-9, abs=1e-9)
+
+    def test_hash_counts_high_cardinality_aborts(self):
+        rng = np.random.default_rng(40)
+        big = rng.lognormal(3, 1, 200_000)
+        assert counts_family.hash_counts_for_column(big, None, None) is None
+        # object/str columns are not eligible at all
+        assert (
+            counts_family.hash_counts_for_column(
+                np.array(["a"], dtype=object), None, None
+            )
+            is None
+        )
+
+    def test_hash_counts_skew_guard_bails_on_late_tail(self):
+        """A column whose distinct count exceeds the cap only in a late
+        tail (the Zipf/skew worst case) must abort after the bounded
+        probe prefix, not after scanning nearly everything."""
+        rng = np.random.default_rng(41)
+        n = 1_500_000
+        head = rng.integers(0, 64_000, int(n * 0.95)).astype(np.float64)
+        tail = rng.integers(64_000, 72_000, n - len(head)).astype(
+            np.float64
+        )
+        vals = np.concatenate([head, tail])
+        import time
+
+        t0 = time.process_time()
+        assert counts_family.hash_counts_for_column(vals, None, None) is None
+        # bounded prefix: well under a full ~12ns/row scan of 1.5M rows
+        # (generous 4x margin for slow box phases)
+        assert time.process_time() - t0 < 0.04
+
     def test_int64_extreme_sentinels_stay_successful(self):
         """Columns of Long.MIN/MAX-adjacent sentinels: the speculative
         window must clamp inside int64 (no ctypes wrap, no OverflowError)
