@@ -49,18 +49,27 @@ def random_table(rng: np.random.Generator) -> Table:
     s = pool[rng.integers(0, len(pool), n)]
     s[rng.random(n) < null_density] = None
     g = rng.integers(0, max(1, cardinality), n)
+    # low-cardinality float (discount/tax-style): exercises the
+    # hash-count family fast path across every engine, with explicit
+    # -0.0 keys (a distinct bit pattern the f64_key order must place
+    # before +0.0)
+    r = rng.integers(-2, 11, n) / 100.0
+    r[rng.random(n) < 0.1] = -0.0
+    r[rng.random(n) < null_density] = np.nan
     return Table.from_pydict(
         {
             "x": list(x),
             "y": list(y),
             "s": list(s),
             "g": [int(v) for v in g],
+            "r": list(r),
         },
         types={
             "x": ColumnType.DOUBLE,
             "y": ColumnType.DOUBLE,
             "s": ColumnType.STRING,
             "g": ColumnType.LONG,
+            "r": ColumnType.DOUBLE,
         },
     )
 
@@ -100,6 +109,18 @@ def random_check(rng: np.random.Generator) -> Check:
         lambda c: c.has_min("x", lambda v, t=stat_t: v <= t),
         lambda c: c.has_max("x", lambda v, t=stat_t: v >= t),
         lambda c: c.has_mean("x", lambda v, t=stat_t: v >= t),
+        # low-card float column: the hash-count family path
+        lambda c: c.has_mean("r", lambda v, t=frac_t: v >= t * 0.1),
+        lambda c: c.has_min("r", lambda v: v >= -0.02),
+        lambda c: c.has_standard_deviation(
+            "r", lambda v, t=frac_t: v <= max(t, 0.2)
+        ),
+        lambda c: c.has_approx_quantile(
+            "r", 0.5, lambda v, t=far: (v >= t) if t < 0 else (v <= t)
+        ),
+        lambda c: c.has_approx_count_distinct(
+            "r", lambda v, t=far: (v >= t) if t < 0 else (v <= t)
+        ),
         lambda c: c.has_sum("x", lambda v, t=stat_t: v >= t),
         lambda c: c.has_standard_deviation("x", lambda v, t=frac_t: v >= t),
         lambda c: c.has_correlation(
