@@ -17,9 +17,13 @@ our rows/s divided by that proxy; the build target is >=10.
 
 Knobs (env):
     BENCH_ROWS      rows to profile           (default 10_000_000)
-    BENCH_MODE      "profiler" | "scan" | "stream"  (default "profiler")
+    BENCH_MODE      "profiler" | "scan" | "stream" | "wide" | "lineitem"
+                    (default "profiler")
                     stream = full profile over an on-disk Parquet file via
                     Table.scan_parquet (out-of-core; constant host memory)
+                    wide = the BASELINE.json 50-column north-star shape;
+                    lineitem = 16-column TPC-H lineitem-like (both use a
+                    best-of-3 measured SAME-SHAPE pandas denominator)
     BENCH_TIMED     timed repetitions, best-of (default 5: shared-vCPU
                      boxes show 20-30% run-to-run noise; best-of-5 reads
                      the machine's actual capability. Compile happens
