@@ -30,6 +30,10 @@ Knobs (env):
                      during the warmup run)
     BENCH_PARQUET   path for the stream-mode file (default /tmp/bench.parquet;
                      reused if it already has BENCH_ROWS rows)
+    BENCH_COLD      "1" + mode=stream: ONE cold pass (no warmup, no reps)
+                    timed end-to-end incl. jit compile — the methodology
+                    behind BENCH_STREAM_100M/1B.json; adds rows/elapsed_s/
+                    peak_rss_mb fields to the JSON line
     BENCH_PLATFORM  force a jax platform ("cpu" | "tpu" | unset=default).
                      The JAX_PLATFORMS env var does NOT override the axon
                      TPU plugin on this box; this knob forces it in code.
@@ -543,25 +547,47 @@ def main() -> None:
             baseline = float(baseline_env)
             baseline_note = "override"
 
-    # warmup: compiles every (analyzer-set, padded-shape) program
-    t_warm = time.perf_counter()
-    run(table)
-    warm_s = time.perf_counter() - t_warm
-
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        run(table)
-        times.append(time.perf_counter() - t0)
-    best = min(times)
-    rows_per_sec = n_rows / best
-
     import resource
 
+    cold = mode == "stream" and os.environ.get("BENCH_COLD", "") in (
+        "1",
+        "true",
+    )
+    extra = {}
+    if cold:
+        # the BENCH_STREAM_*.json methodology: ONE cold end-to-end pass
+        # incl. jit compile; every stream batch decodes fresh either way
+        t0 = time.perf_counter()
+        run(table)
+        best = time.perf_counter() - t0
+        warm_s = 0.0
+        extra = {
+            "rows": n_rows,
+            "elapsed_s": round(best, 1),
+            "peak_rss_mb": round(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+            ),
+        }
+    else:
+        # warmup: compiles every (analyzer-set, padded-shape) program
+        t_warm = time.perf_counter()
+        run(table)
+        warm_s = time.perf_counter() - t_warm
+
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run(table)
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+    rows_per_sec = n_rows / best
+
     peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    warm_note = "none (single cold pass)" if cold else f"{warm_s:.1f}s"
     print(
-        f"# bench: mode={mode} rows={n_rows} gen={gen_s:.1f}s "
-        f"warmup={warm_s:.1f}s timed={best:.2f}s peak_rss={peak_rss_mb:.0f}MB "
+        f"# bench: mode={mode}{' (cold)' if cold else ''} rows={n_rows} "
+        f"gen={gen_s:.1f}s warmup={warm_note} timed={best:.2f}s "
+        f"peak_rss={peak_rss_mb:.0f}MB "
         f"baseline={baseline / 1e6:.2f}M rows/s [{baseline_note}]",
         file=sys.stderr,
     )
@@ -572,6 +598,7 @@ def main() -> None:
                 "value": round(rows_per_sec, 1),
                 "unit": "rows/s",
                 "vs_baseline": round(rows_per_sec / baseline, 3),
+                **extra,
                 "pallas_onchip": pallas_onchip_check(),
             }
         )
