@@ -560,14 +560,6 @@ def main() -> None:
         t0 = time.perf_counter()
         run(table)
         best = time.perf_counter() - t0
-        warm_s = 0.0
-        extra = {
-            "rows": n_rows,
-            "elapsed_s": round(best, 1),
-            "peak_rss_mb": round(
-                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
-            ),
-        }
     else:
         # warmup: compiles every (analyzer-set, padded-shape) program
         t_warm = time.perf_counter()
@@ -583,6 +575,12 @@ def main() -> None:
     rows_per_sec = n_rows / best
 
     peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    if cold:
+        extra = {
+            "rows": n_rows,
+            "elapsed_s": round(best, 1),
+            "peak_rss_mb": round(peak_rss_mb),
+        }
     warm_note = "none (single cold pass)" if cold else f"{warm_s:.1f}s"
     print(
         f"# bench: mode={mode}{' (cold)' if cold else ''} rows={n_rows} "

@@ -665,15 +665,20 @@ class Table:
         import pyarrow as pa
 
         data = {}
-        for name, _ctype in self.schema:
+        for name, ctype in self.schema:
             col = self.column(name)
             values = col.values
             valid = np.asarray(col.valid)
             if values.dtype == object:
+                # explicit string type: an ALL-NULL column would
+                # otherwise infer arrow's null type, whose
+                # dictionary_encode produces a DictionaryArray parquet
+                # cannot write ("null encoded in dictionary")
                 arr = pa.array(
-                    [v if ok else None for v, ok in zip(values, valid)]
+                    [v if ok else None for v, ok in zip(values, valid)],
+                    type=pa.string() if ctype == ColumnType.STRING else None,
                 )
-                if dictionary_encode_strings:
+                if dictionary_encode_strings and pa.types.is_string(arr.type):
                     arr = arr.dictionary_encode()
             else:
                 arr = pa.array(values, mask=~valid)

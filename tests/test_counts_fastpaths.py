@@ -322,6 +322,26 @@ class TestDictionaryContentMemo:
         assert ok2.all()
 
 
+class TestToArrowDegenerate:
+    def test_all_null_string_column_round_trips(self, tmp_path):
+        """An all-null string column must not infer arrow's null type:
+        dictionary-encoding a null-typed array produces a
+        DictionaryArray parquet cannot write (regression: round-5 soak
+        fuzz)."""
+        from deequ_tpu.data.table import ColumnType, Table
+
+        t = Table.from_pydict(
+            {"s": [None, None, None], "x": [1.0, None, 3.0]},
+            types={"s": ColumnType.STRING, "x": ColumnType.DOUBLE},
+        )
+        path = str(tmp_path / "allnull.parquet")
+        t.to_parquet(path, dictionary_encode_strings=True)
+        back = Table.from_parquet(path)
+        assert back.column("s").null_count == 3
+        assert back.column("s").ctype == ColumnType.STRING
+        assert back.column("x").null_count == 1
+
+
 class TestDataTypeFromCounts:
     def _datatype_agg(self, table, monkeypatch=None, disable=False):
         from deequ_tpu.runners import AnalysisRunner
