@@ -10,6 +10,7 @@ reductions. Replaces the role Spark's DataFrame plays for the reference
 from __future__ import annotations
 
 import enum
+import threading
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -264,6 +265,9 @@ _DICT_DERIVED_MAX = 256
 # arrays for the process lifetime (the bounded-RSS stream contract)
 _DICT_DERIVED_MAX_BYTES = 32 << 20
 _DICT_DERIVED_BYTES = 0
+# family kernels run dictionary encodes from a thread pool (fused.py):
+# the OrderedDict reorder/evict and the byte counter are not atomic
+_DICT_DERIVED_LOCK = threading.Lock()
 
 
 def _derived_nbytes(value) -> int:
@@ -292,29 +296,31 @@ def cached_dictionary_encode(col: "Column", key: str, compute):
         return cached
     content_key = root._dict_content_key
     if content_key is not None:
-        if _DICT_DERIVED_CACHE is None:
-            from collections import OrderedDict
+        with _DICT_DERIVED_LOCK:
+            if _DICT_DERIVED_CACHE is None:
+                from collections import OrderedDict
 
-            _DICT_DERIVED_CACHE = OrderedDict()
-        hit = _DICT_DERIVED_CACHE.get((content_key, key))
-        if hit is not None:
-            _DICT_DERIVED_CACHE.move_to_end((content_key, key))
-            root._cache[key] = hit[0]
-            return hit[0]
+                _DICT_DERIVED_CACHE = OrderedDict()
+            hit = _DICT_DERIVED_CACHE.get((content_key, key))
+            if hit is not None:
+                _DICT_DERIVED_CACHE.move_to_end((content_key, key))
+                root._cache[key] = hit[0]
+                return hit[0]
     value = compute(root)
     root._cache[key] = value
     if content_key is not None:
         nbytes = _derived_nbytes(value)
-        _DICT_DERIVED_CACHE[(content_key, key)] = (value, nbytes)
-        _DICT_DERIVED_BYTES += nbytes
-        while _DICT_DERIVED_CACHE and (
-            len(_DICT_DERIVED_CACHE) > _DICT_DERIVED_MAX
-            or _DICT_DERIVED_BYTES > _DICT_DERIVED_MAX_BYTES
-        ):
-            _key, (_value, evicted_bytes) = _DICT_DERIVED_CACHE.popitem(
-                last=False
-            )
-            _DICT_DERIVED_BYTES -= evicted_bytes
+        with _DICT_DERIVED_LOCK:
+            _DICT_DERIVED_CACHE[(content_key, key)] = (value, nbytes)
+            _DICT_DERIVED_BYTES += nbytes
+            while _DICT_DERIVED_CACHE and (
+                len(_DICT_DERIVED_CACHE) > _DICT_DERIVED_MAX
+                or _DICT_DERIVED_BYTES > _DICT_DERIVED_MAX_BYTES
+            ):
+                _key, (_value, evicted_bytes) = _DICT_DERIVED_CACHE.popitem(
+                    last=False
+                )
+                _DICT_DERIVED_BYTES -= evicted_bytes
     return value
 
 
@@ -592,7 +598,21 @@ class Table:
                 if nan.any():
                     valid = valid & ~nan
                     vals = np.where(valid, vals, 0.0)
-                cols.append(Column(name, ColumnType.DOUBLE, vals, valid))
+                # a float64 field annotated by to_arrow keeps its
+                # DECIMAL ctype across the arrow/parquet round trip
+                # (values were float64 already; only the logical type
+                # needs restoring)
+                try:
+                    md = arrow_table.schema.field(name).metadata or {}
+                except Exception:  # noqa: BLE001 - schemaless inputs
+                    md = {}
+                ctype = (
+                    ColumnType.DECIMAL
+                    if md.get(b"deequ_tpu.logical_type")
+                    == ColumnType.DECIMAL.value.encode()
+                    else ColumnType.DOUBLE
+                )
+                cols.append(Column(name, ctype, vals, valid))
             elif pa.types.is_decimal(t):
                 vals = np.array(
                     [float(v) if v is not None else 0.0 for v in arr.to_pylist()],
@@ -661,10 +681,17 @@ class Table:
         """Arrow table with faithful nulls: the Column neutral-fill
         contract is inverted (null slots become arrow nulls, not the
         0.0/""/False fillers). The single conversion used by every
-        write-to-parquet path (tests, dryruns, bench)."""
+        write-to-parquet path (tests, dryruns, bench).
+
+        DECIMAL columns are float64-backed in memory (the precision was
+        already capped at ingest — see `from_arrow`), so they emit as
+        float64 with the logical type recorded in field metadata
+        (``deequ_tpu.logical_type = DecimalType``). A round trip through
+        arrow/parquet keeps the DecimalType ctype but NOT decimal
+        precision beyond float64's 53 bits."""
         import pyarrow as pa
 
-        data = {}
+        arrays, fields = [], []
         for name, ctype in self.schema:
             col = self.column(name)
             values = col.values
@@ -682,8 +709,14 @@ class Table:
                     arr = arr.dictionary_encode()
             else:
                 arr = pa.array(values, mask=~valid)
-            data[name] = arr
-        return pa.table(data)
+            metadata = (
+                {b"deequ_tpu.logical_type": ctype.value.encode()}
+                if ctype == ColumnType.DECIMAL
+                else None
+            )
+            fields.append(pa.field(name, arr.type, metadata=metadata))
+            arrays.append(arr)
+        return pa.table(arrays, schema=pa.schema(fields))
 
     def to_parquet(self, path: str, row_group_size: Optional[int] = None,
                    dictionary_encode_strings: bool = False) -> None:

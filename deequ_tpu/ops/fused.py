@@ -376,12 +376,15 @@ def fold_host_batch(
     host_errors: Dict[int, BaseException],
     batch=None,
     streaming: bool = False,
+    family_memo: Optional[Dict] = None,
 ) -> None:
     """One batch's host-placed fold, shared by FusedScanPass and
     DistributedScanPass: merge members run their xp-generic reduce with
     numpy; assisted members (sketches) run the SAME per-batch computation
     the device would (sort+decimate) and fold via host_consume. A failed
-    input fails only the members that need it."""
+    input fails only the members that need it. `family_memo` is a dict
+    the caller keeps alive for the whole scan: cross-batch facts (e.g.
+    which columns miss the counts shortcut) persist across batches."""
     _precompute_family_kernels(
         built,
         host_assisted,
@@ -389,6 +392,7 @@ def fold_host_batch(
         host_members=host_members,
         host_errors=host_errors,
         streaming=streaming,
+        family_memo=family_memo,
     )
     # assisted members fold FIRST: some publish per-batch memos that
     # merge members answer from (e.g. _LowCardCounts' dictionary
@@ -515,6 +519,17 @@ def _counts_family_shortcut(
                 counts, lo, cap, n_where, want_regs
             )
     if derived is None:
+        n_v = len(values)
+        if n_v > 262144:
+            # sample pre-check before the ~262k-slot hash probe: a
+            # strided 4096-row sample that is nearly all-distinct
+            # (>4000; a 65536-distinct population — the counter's
+            # bound — expects ~3969) implies the full column is far
+            # beyond the bound and the probe is guaranteed to abort.
+            # A wrong skip only costs the shortcut, never correctness.
+            sample = values[:: n_v // 4096][:4096]
+            if np.unique(sample).size > 4000:
+                return False
         hres = counts_family.hash_counts_for_column(values, valid, warr)
         if hres is None:
             return False
@@ -551,6 +566,7 @@ def _precompute_family_kernels(
     host_members=(),
     host_errors=(),
     streaming: bool = False,
+    family_memo: Optional[Dict] = None,
 ) -> None:
     """Host-fold scan sharing ACROSS analyzer kinds: when a quantile
     sketch rides the pass, one combined C traversal produces the
@@ -564,7 +580,18 @@ def _precompute_family_kernels(
     the whole family from the value distribution (ops/counts_family).
     Results land in the per-batch memo keys the members already read;
     any failure simply leaves the memos unset and each member computes
-    on its own."""
+    on its own.
+
+    `family_memo` (optional, scoped to ONE scan/stream by the caller)
+    carries cross-batch facts: a column that failed the counts shortcut
+    once (high-cardinality, wrong dtype) fails it for every batch of the
+    stream, so the probe is skipped after the first miss.
+
+    Same-(where, cap) families are batched into ONE multi-column native
+    traversal (masked_moments_select_multi) — the across-column leg of
+    scan sharing. `DEEQU_TPU_NO_MULTI_FAMILY=1` forces the per-column
+    kernel (the batched path is bit-identical; the toggle exists for
+    parity testing and triage)."""
     from deequ_tpu.analyzers.base import where_key
     from deequ_tpu.ops import counts_family, native
 
@@ -594,13 +621,27 @@ def _precompute_family_kernels(
             continue
         rkey = f"__hllregs:{column}:{wkey}"
         want_regs = (column, wkey) in acd_families
-        try:
-            shortcut = counts_ok and _counts_family_shortcut(
-                built, batch, column, where, wkey, cap, want_regs,
-                qkey, mkey, rkey,
-            )
-        except Exception:  # noqa: BLE001 - memo stays unset, select runs
-            shortcut = False
+        miss_key = ("counts_miss", column, wkey)
+        if family_memo is not None and miss_key in family_memo:
+            shortcut = False  # known miss: same column, same stream
+        else:
+            try:
+                shortcut = counts_ok and _counts_family_shortcut(
+                    built, batch, column, where, wkey, cap, want_regs,
+                    qkey, mkey, rkey,
+                )
+            except Exception:  # noqa: BLE001 - memo stays unset, select runs
+                shortcut = False
+            if (
+                not shortcut
+                and counts_ok
+                and batch is not None
+                and family_memo is not None
+            ):
+                # the miss reasons (dtype, cardinality beyond the hash
+                # counter) are column properties, stable across a
+                # stream's batches — don't re-probe ~262k rows per batch
+                family_memo[miss_key] = True
         if shortcut:
             continue
         try:
@@ -613,17 +654,24 @@ def _precompute_family_kernels(
                 continue
         except Exception:  # noqa: BLE001 - memo stays unset, members recompute
             continue
+        if valid.all():
+            # all-valid elision: identical results, and it unlocks the
+            # kernels' unmasked fast paths (branchless key transform,
+            # quad-interleaved accumulation in the batched kernel)
+            valid = None
         if want_regs and streaming:
             hll_mode, hashvals = _family_hll_mode(batch, column)
         else:
             hll_mode, hashvals = 0, None
-        jobs.append((qkey, mkey, rkey, x, valid, warr, cap, hll_mode, hashvals))
+        jobs.append(
+            (qkey, mkey, rkey, x, valid, warr, cap, hll_mode, hashvals, wkey)
+        )
 
     if not jobs:
         return
 
     def run_one(job):
-        qkey, mkey, rkey, x, valid, warr, cap, hll_mode, hashvals = job
+        qkey, mkey, rkey, x, valid, warr, cap, hll_mode, hashvals, _w = job
         try:
             return (
                 native.masked_moments_select(
@@ -634,14 +682,41 @@ def _precompute_family_kernels(
         except Exception:  # noqa: BLE001
             return None, len(x)
 
-    if len(jobs) > 1 and (os.cpu_count() or 1) > 1:
-        # the C kernel releases the GIL: independent column families run
+    # batch same-(where, cap) same-length families into one traversal;
+    # singleton groups keep the solo kernel (same machinery, no batching
+    # overhead to amortize)
+    no_multi = os.environ.get("DEEQU_TPU_NO_MULTI_FAMILY", "") not in ("", "0")
+    group_map: Dict[Any, list] = {}
+    for idx, job in enumerate(jobs):
+        group_map.setdefault((job[9], job[6], len(job[3])), []).append(idx)
+    groups = list(group_map.values())
+
+    def run_group(idxs):
+        if len(idxs) > 1 and not no_multi:
+            g = [jobs[i] for i in idxs]
+            try:
+                outs = native.masked_moments_select_multi(
+                    [(j[3], j[4], j[7], j[8]) for j in g], g[0][5], g[0][6]
+                )
+            except Exception:  # noqa: BLE001
+                outs = None
+            if outs is not None:
+                return [(res, len(j[3])) for j, res in zip(g, outs)]
+            # batched kernel unavailable/failed: per-column fallback
+        return [run_one(jobs[i]) for i in idxs]
+
+    if len(groups) > 1 and (os.cpu_count() or 1) > 1:
+        # the C kernel releases the GIL: independent family groups run
         # concurrently on multicore hosts (a no-op gain on 1-core boxes).
         # ONE long-lived pool: the kernel keeps grow-only thread-local
         # arenas, so short-lived per-batch threads would leak them.
-        outcomes = list(_family_pool().map(run_one, jobs))
+        group_outs = list(_family_pool().map(run_group, groups))
     else:
-        outcomes = [run_one(j) for j in jobs]
+        group_outs = [run_group(g) for g in groups]
+    outcomes: list = [None] * len(jobs)
+    for idxs, outs in zip(groups, group_outs):
+        for idx, out in zip(idxs, outs):
+            outcomes[idx] = out
 
     for (qkey, mkey, rkey, *_rest), (res, n_rows) in zip(jobs, outcomes):
         if res is None:
@@ -922,6 +997,7 @@ class FusedScanPass:
                 i: [s.key for s in member.input_specs()] for i, member in all_host
             }
         host_assisted_states: Dict[int, Any] = {}
+        family_memo: Dict[Any, Any] = {}  # cross-batch, one scan's scope
         batch_size = self.batch_size
         if (
             not use_device
@@ -980,7 +1056,7 @@ class FusedScanPass:
             fold_host_batch(
                 built, build_errors, host_members, host_assisted,
                 host_member_keys, host_aggs, host_assisted_states, host_errors,
-                batch=batch, streaming=streaming,
+                batch=batch, streaming=streaming, family_memo=family_memo,
             )
 
         aggs, assisted_states = [], []

@@ -189,6 +189,21 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32),
         ]
         lib.masked_moments_select.restype = ctypes.c_int
+        lib.masked_moments_select_multi.argtypes = [
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.masked_moments_select_multi.restype = ctypes.c_int
         _LIB = lib
     except OSError:
         _LIB = None
@@ -486,6 +501,107 @@ def masked_moments_select(
     if rc != 0:
         return None
     return mom, samples[: int(meta[2])], int(meta[0]), int(meta[1]), regs
+
+
+def masked_moments_select_multi(
+    columns,
+    where: Optional[np.ndarray],
+    cap: int,
+):
+    """Batched family kernel: one row-blocked native traversal computes
+    the fused moments, decimated quantile sample, and optional HLL
+    registers for K columns at once (scan sharing ACROSS columns — the
+    per-column masked_moments_select pays K full passes).
+
+    `columns` is a sequence of (x, valid_or_None, hll_mode, hashvals_or_None)
+    tuples sharing one row count; `where` is the shared row mask for the
+    whole group (grouping by where-mask is the caller's job). Returns a
+    list of per-column (moments6, samples_f64, n_valid, level,
+    registers_or_None) tuples — each bit-identical to what a solo
+    masked_moments_select call would produce — or None when the native
+    library is unavailable, the lengths disagree, or the kernel fails
+    (caller falls back to per-column calls)."""
+    lib = _load()
+    if lib is None:
+        return None
+    k = len(columns)
+    if k == 0:
+        return []
+    PD = ctypes.POINTER(ctypes.c_double)
+    PU8 = ctypes.POINTER(ctypes.c_uint8)
+    PI64 = ctypes.POINTER(ctypes.c_int64)
+    xptrs = (PD * k)()
+    vptrs = (PU8 * k)()
+    hptrs = (PI64 * k)()
+    modes = np.zeros(k, dtype=np.int32)
+    keep = []  # pins converted arrays for the call's duration
+    n = None
+    any_hll = False
+    for idx, (x, valid, hll_mode, hashvals) in enumerate(columns):
+        x = np.ascontiguousarray(x, dtype=np.float64)
+        if n is None:
+            n = len(x)
+        elif len(x) != n:
+            return None
+        keep.append(x)
+        xptrs[idx] = x.ctypes.data_as(PD)
+        v = _u8_ptr(valid)
+        if v is not None:
+            if len(v) != n:
+                return None
+            keep.append(v)
+            vptrs[idx] = v.ctypes.data_as(PU8)
+        if hll_mode == 2 and hashvals is not None:
+            hv = np.ascontiguousarray(hashvals, dtype=np.int64)
+            if len(hv) != n:
+                return None
+            keep.append(hv)
+            hptrs[idx] = hv.ctypes.data_as(PI64)
+        elif hll_mode == 2:
+            hll_mode = 0
+        modes[idx] = int(hll_mode)
+        if hll_mode:
+            any_hll = True
+    where = _u8_ptr(where)
+    if where is not None and len(where) != n:
+        return None
+    cap = max(int(cap), 1)
+    samples = np.empty((k, cap), dtype=np.float64)
+    meta = np.zeros((k, 3), dtype=np.int64)
+    mom = np.zeros((k, 6), dtype=np.float64)
+    regs = np.zeros((k, 512), dtype=np.int32) if any_hll else None
+    rc = lib.masked_moments_select_multi(
+        xptrs,
+        vptrs,
+        where.ctypes.data_as(PU8) if where is not None else None,
+        n,
+        k,
+        cap,
+        samples.ctypes.data_as(PD),
+        meta.ctypes.data_as(PI64),
+        mom.ctypes.data_as(PD),
+        hptrs,
+        modes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        regs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        if regs is not None
+        else None,
+    )
+    del keep
+    if rc != 0:
+        return None
+    out = []
+    for idx in range(k):
+        kept = int(meta[idx, 2])
+        out.append(
+            (
+                mom[idx].copy(),
+                samples[idx, :kept].copy(),
+                int(meta[idx, 0]),
+                int(meta[idx, 1]),
+                regs[idx].copy() if regs is not None and modes[idx] else None,
+            )
+        )
+    return out
 
 
 def hll_update_registers(

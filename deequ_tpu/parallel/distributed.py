@@ -42,6 +42,29 @@ DATA_AXIS = "data"
 _DIST_CACHE: Dict[Any, Any] = {}
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """`jax.shard_map` moved to top level around jax 0.6; on earlier
+    versions (e.g. 0.4.x) it lives in jax.experimental.shard_map and the
+    `check_vma` kwarg is spelled `check_rep`."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 def data_mesh(devices: Optional[Sequence] = None, axis_name: str = DATA_AXIS) -> Mesh:
     """1-D data-parallel mesh over all (or given) devices."""
     devices = list(devices) if devices is not None else jax.devices()
@@ -99,7 +122,7 @@ def _get_distributed_fn(analyzers, mesh: Mesh, axis_name: str, assisted=()):
         assisted_out = tuple(a.device_batch(inputs, jnp) for a in assisted)
         return tuple(merged), assisted_out
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         per_device,
         mesh=mesh,
         in_specs=(P(axis_name),),
@@ -201,6 +224,7 @@ class DistributedScanPass:
         host_assisted_states: Dict[int, Any] = {}
         host_errors: Dict[int, BaseException] = {}
         sticky: Dict[str, Any] = {}
+        family_memo: Dict[Any, Any] = {}  # cross-batch, one scan's scope
         streaming = bool(getattr(table, "is_streaming", False))
         try:
             fold = PipelinedAggFold(
@@ -271,6 +295,7 @@ class DistributedScanPass:
                     host_member_keys, host_aggs, host_assisted_states,
                     host_errors,
                     batch=batch, streaming=streaming,
+                    family_memo=family_memo,
                 )
             aggs, assisted_states = [], []
             if device_error is None:
@@ -345,7 +370,7 @@ def sharded_bincount(
             return jax.lax.psum(counts, axis_name)
 
         fn = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 per_device,
                 mesh=mesh,
                 in_specs=(P(axis_name),),

@@ -1,0 +1,301 @@
+"""Parity tests for the multi-column batched family kernel:
+
+1. `native.masked_moments_select_multi` — K columns folded in one
+   row-blocked traversal must be BIT-IDENTICAL (moments, decimated
+   samples, HLL registers, meta) to K solo `masked_moments_select`
+   calls, across where masks, null masks, constant/compact/all-null
+   columns and both HLL modes.
+2. The fused.py grouping layer — same-(where, cap) families dispatch
+   ONE batched call; `DEEQU_TPU_NO_MULTI_FAMILY=1` forces the
+   per-column kernel and end-to-end metrics must not move at all.
+3. Streaming — a multi-batch parquet scan under the toggle equals the
+   batched path, and the counts-shortcut miss is probed once per
+   (column, where) per stream, not once per batch.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import pytest
+
+from deequ_tpu.ops import native
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native kernels unavailable"
+)
+
+
+def _solo(x, valid, where, cap, hll_mode=0, hashvals=None):
+    return native.masked_moments_select(
+        x, valid, where, cap, hll_mode=hll_mode, hashvals=hashvals
+    )
+
+
+def _assert_bit_identical(multi_out, solo_out, tag):
+    mom_m, sample_m, n_m, lvl_m, regs_m = multi_out
+    mom_s, sample_s, n_s, lvl_s, regs_s = solo_out
+    assert (n_m, lvl_m) == (n_s, lvl_s), tag
+    assert np.array_equal(mom_m, mom_s, equal_nan=True), (tag, mom_m, mom_s)
+    assert np.array_equal(sample_m, sample_s), tag
+    assert (regs_m is None) == (regs_s is None), tag
+    if regs_m is not None:
+        assert np.array_equal(regs_m, regs_s), tag
+
+
+@needs_native
+class TestMultiKernelBitExact:
+    def _check_group(self, columns, where, cap, tag):
+        outs = native.masked_moments_select_multi(columns, where, cap)
+        assert outs is not None, tag
+        assert len(outs) == len(columns), tag
+        for i, (x, valid, hll_mode, hashvals) in enumerate(columns):
+            solo = _solo(x, valid, where, cap, hll_mode, hashvals)
+            _assert_bit_identical(outs[i], solo, (tag, i))
+
+    @pytest.mark.parametrize("with_where", [False, True])
+    def test_mixed_columns(self, with_where):
+        rng = np.random.default_rng(3 if with_where else 2)
+        n = 120_000
+        columns = []
+        for i in range(7):
+            kind = i % 4
+            if kind == 0:
+                x = rng.random(n) * (i + 1)
+            elif kind == 1:
+                x = rng.lognormal(2.0, 1.0, n)
+            elif kind == 2:
+                x = rng.integers(0, 10**9, n).astype(np.float64)
+            else:
+                # compact key prefix: every key shares one top bucket
+                x = 100.0 + rng.random(n) * 1e-9
+            valid = None
+            if i % 3 == 1:
+                valid = rng.random(n) > 0.1
+            hll_mode = i % 3  # off / f64-bits / canonical-int64
+            hashvals = (
+                rng.integers(-(2**62), 2**62, n) if hll_mode == 2 else None
+            )
+            columns.append((x, valid, hll_mode, hashvals))
+        where = (rng.random(n) > 0.4) if with_where else None
+        self._check_group(columns, where, 460, f"mixed:{with_where}")
+
+    def test_degenerate_columns(self):
+        rng = np.random.default_rng(5)
+        n = 50_000
+        one_valid = np.zeros(n, dtype=bool)
+        one_valid[123] = True
+        one_val = np.zeros(n)
+        one_val[123] = -42.5
+        columns = [
+            (np.full(n, 3.25), None, 1, None),  # constant
+            (np.full(n, np.nan), np.zeros(n, dtype=bool), 0, None),  # all-null
+            (one_val, one_valid, 0, None),  # single survivor
+            (rng.lognormal(0, 2, n), None, 0, None),  # regular companion
+        ]
+        self._check_group(columns, None, 64, "degenerate")
+        self._check_group(
+            columns, np.zeros(n, dtype=bool), 64, "degenerate-where-none"
+        )
+
+    @pytest.mark.parametrize("n", [0, 1, 5, 47, 2048, 2049])
+    def test_tiny_inputs(self, n):
+        rng = np.random.default_rng(n + 50)
+        columns = [
+            (rng.random(n) * 3, None, 1, None),
+            (
+                rng.lognormal(0.0, 2.0, n),
+                rng.random(n) > 0.5 if n else np.zeros(0, dtype=bool),
+                0,
+                None,
+            ),
+        ]
+        self._check_group(columns, None, 32, f"n={n}")
+
+    @pytest.mark.parametrize("cap", [16, 64, 1024, 4096])
+    def test_cap_sweep(self, cap):
+        rng = np.random.default_rng(cap)
+        n = 200_000
+        columns = [
+            (rng.random(n) * 7, None, 0, None),
+            (rng.lognormal(2.0, 1.0, n), None, 0, None),
+            (rng.integers(0, 10**9, n).astype(np.float64), None, 0, None),
+        ]
+        self._check_group(columns, None, cap, f"cap={cap}")
+
+    def test_length_mismatch_returns_none(self):
+        rng = np.random.default_rng(9)
+        columns = [
+            (rng.random(100), None, 0, None),
+            (rng.random(99), None, 0, None),
+        ]
+        assert native.masked_moments_select_multi(columns, None, 32) is None
+
+
+def _family_table(n=200_000, seed=13):
+    """High-cardinality float columns — enough rows that the distinct
+    count exceeds the hash counter's 65536 bound, so the counts shortcut
+    MISSES and the select-family kernel runs."""
+    from deequ_tpu.data.table import Table
+
+    rng = np.random.default_rng(seed)
+    return Table.from_numpy(
+        {
+            "a": rng.lognormal(1.0, 0.7, n),
+            "b": rng.random(n) * 1000.0,
+            "c": rng.standard_normal(n) * 50.0,
+            "flag": rng.random(n) < 0.5,
+        }
+    )
+
+
+def _run_family_analysis(table):
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        ApproxQuantile,
+        ApproxQuantiles,
+        Mean,
+        StandardDeviation,
+    )
+    from deequ_tpu.runners import AnalysisRunner
+
+    analyzers = []
+    for col in ("a", "b", "c"):
+        analyzers += [
+            ApproxQuantiles(col, (0.25, 0.5, 0.75)),
+            Mean(col),
+            StandardDeviation(col),
+            ApproxCountDistinct(col),
+        ]
+    analyzers.append(ApproxQuantile("a", 0.5, where="flag"))
+    analyzers.append(Mean("b", where="flag"))
+    res = AnalysisRunner.on_data(table).add_analyzers(analyzers).run()
+    out = {}
+    for analyzer, metric in res.metric_map.items():
+        assert metric.value.is_success, (analyzer, metric.value)
+        out[repr(analyzer)] = metric.value.get()
+    return out
+
+
+def _pin_sketch_seeds(monkeypatch):
+    from deequ_tpu.analyzers import sketch as sketch_mod
+
+    monkeypatch.setattr(sketch_mod, "_BATCH_SEED_COUNTER", itertools.count(1))
+
+
+@pytest.fixture
+def host_placed(monkeypatch):
+    """Force host placement: the family kernels only run for HOST-folded
+    sketch members (device-placed sketches never reach them)."""
+    monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "host")
+
+
+@needs_native
+class TestGroupedDispatchParity:
+    def test_end_to_end_equal_under_toggle(self, monkeypatch, host_placed):
+        _pin_sketch_seeds(monkeypatch)
+        batched = _run_family_analysis(_family_table())
+        monkeypatch.setenv("DEEQU_TPU_NO_MULTI_FAMILY", "1")
+        _pin_sketch_seeds(monkeypatch)
+        solo = _run_family_analysis(_family_table())
+        assert batched.keys() == solo.keys()
+        for key in batched:
+            bv, sv = batched[key], solo[key]
+            if isinstance(bv, dict):
+                assert bv.keys() == sv.keys(), key
+                for q in bv:
+                    assert bv[q] == sv[q], (key, q)  # bit-identical
+            else:
+                assert bv == sv, key  # bit-identical
+
+    def test_multi_kernel_engages_and_toggle_disables(self, monkeypatch, host_placed):
+        calls = {"multi": 0, "solo": 0}
+        real_multi = native.masked_moments_select_multi
+        real_solo = native.masked_moments_select
+
+        def count_multi(columns, where, cap):
+            calls["multi"] += 1
+            return real_multi(columns, where, cap)
+
+        def count_solo(*a, **k):
+            calls["solo"] += 1
+            return real_solo(*a, **k)
+
+        monkeypatch.setattr(
+            native, "masked_moments_select_multi", count_multi
+        )
+        monkeypatch.setattr(native, "masked_moments_select", count_solo)
+        _run_family_analysis(_family_table())
+        # a/b/c share (no-where, cap): one batched call; the where-group
+        # has a single sketch member and stays on the solo kernel
+        assert calls["multi"] >= 1
+        assert calls["solo"] <= 2
+
+        calls.update(multi=0, solo=0)
+        monkeypatch.setenv("DEEQU_TPU_NO_MULTI_FAMILY", "1")
+        _run_family_analysis(_family_table())
+        assert calls["multi"] == 0
+        assert calls["solo"] >= 3
+
+    def test_streaming_batches_equal_under_toggle(
+        self, tmp_path, monkeypatch, host_placed
+    ):
+        path = str(tmp_path / "stream.parquet")
+        # >65536 distinct values PER BATCH: every batch runs the select
+        # family kernels, not the counts shortcut
+        _family_table(n=300_000, seed=21).to_parquet(
+            path, row_group_size=100_000
+        )
+        from deequ_tpu.data.table import Table
+
+        def stream():
+            return Table.scan_parquet(path, batch_rows=100_000)
+
+        _pin_sketch_seeds(monkeypatch)
+        batched = _run_family_analysis(stream())
+        monkeypatch.setenv("DEEQU_TPU_NO_MULTI_FAMILY", "1")
+        _pin_sketch_seeds(monkeypatch)
+        solo = _run_family_analysis(stream())
+        assert batched.keys() == solo.keys()
+        for key in batched:
+            assert batched[key] == solo[key], key
+
+
+class TestCountsMissMemo:
+    def test_probe_runs_once_per_stream(self, tmp_path, monkeypatch, host_placed):
+        """High-cardinality columns miss the counts shortcut on the
+        first batch; later batches of the same scan must skip the
+        ~262k-row probe entirely (the memo lives for the scan, so a
+        SECOND scan probes again). A probe that SUCCEEDS is not counted
+        against the memo — success means the probe IS the family
+        computation (the a:flag family here stays under the hash
+        counter's distinct bound per batch, so it legitimately runs
+        every batch)."""
+        from deequ_tpu.data.table import Table
+        from deequ_tpu.ops import counts_family
+
+        path = str(tmp_path / "memo.parquet")
+        _family_table(n=300_000, seed=22).to_parquet(
+            path, row_group_size=100_000
+        )
+        probes = {"miss": 0}
+        real = counts_family.hash_counts_for_column
+
+        def counting(*a, **k):
+            res = real(*a, **k)
+            if res is None:
+                probes["miss"] += 1
+            return res
+
+        monkeypatch.setattr(
+            counts_family, "hash_counts_for_column", counting
+        )
+        _run_family_analysis(Table.scan_parquet(path, batch_rows=100_000))
+        # 4 live sketch (column, where) families, 3 batches: without the
+        # memo each high-cardinality family would MISS once per BATCH
+        assert 0 < probes["miss"] <= 4
+        first_scan = probes["miss"]
+        # the memo is scoped to one scan: a fresh scan probes again
+        _run_family_analysis(Table.scan_parquet(path, batch_rows=100_000))
+        assert probes["miss"] == 2 * first_scan, probes["miss"]
