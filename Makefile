@@ -1,0 +1,12 @@
+PY ?= python
+
+.PHONY: lint test test-fast
+
+lint:
+	$(PY) tools/lint.py
+
+test: lint
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
+
+test-fast:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' -x

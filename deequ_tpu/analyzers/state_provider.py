@@ -22,7 +22,6 @@ upgrading across a hash change.
 from __future__ import annotations
 
 import hashlib
-import os
 import struct
 import threading
 from typing import TYPE_CHECKING, Dict, Optional

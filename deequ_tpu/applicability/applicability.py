@@ -66,7 +66,12 @@ def generate_random_data(
             values = rng.random(num_records)
         elif fld.ctype == ColumnType.DECIMAL:
             digits = fld.precision - fld.scale
-            whole = rng.integers(10 ** (digits - 1), 10**digits, num_records)
+            # precision == scale means no whole digits: whole part is 0
+            # (10**(digits-1) would be the float 0.1 and rng.integers
+            # rejects it)
+            lo = 10 ** (digits - 1) if digits > 0 else 0
+            hi = 10**digits if digits > 0 else 1
+            whole = rng.integers(lo, hi, num_records)
             frac = rng.integers(0, 10**fld.scale, num_records) if fld.scale > 0 else 0
             values = whole + (frac / (10**fld.scale) if fld.scale > 0 else 0.0)
             values = values.astype(np.float64)
@@ -86,16 +91,127 @@ def generate_random_data(
     return Table(columns)
 
 
+def _statically_decidable(analyzer) -> bool:
+    """True when the static pass alone decides this analyzer's
+    applicability: its failure modes are all plan-time facts
+    (preconditions, expression parsing, column resolution, regex
+    validity). User-supplied callables (Histogram binning UDFs) can fail
+    in ways no static pass sees, so they keep the dynamic dry-run."""
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        ApproxQuantile,
+        ApproxQuantiles,
+        Completeness,
+        Compliance,
+        Correlation,
+        CountDistinct,
+        DataType,
+        Distinctness,
+        Entropy,
+        Histogram,
+        Maximum,
+        Mean,
+        Minimum,
+        MutualInformation,
+        PatternMatch,
+        Size,
+        StandardDeviation,
+        Sum,
+        UniqueValueRatio,
+        Uniqueness,
+    )
+
+    if isinstance(analyzer, Histogram):
+        return analyzer.binning_udf is None
+    return isinstance(
+        analyzer,
+        (
+            ApproxCountDistinct,
+            ApproxQuantile,
+            ApproxQuantiles,
+            Completeness,
+            Compliance,
+            Correlation,
+            CountDistinct,
+            DataType,
+            Distinctness,
+            Entropy,
+            Maximum,
+            Mean,
+            Minimum,
+            MutualInformation,
+            PatternMatch,
+            Size,
+            StandardDeviation,
+            Sum,
+            UniqueValueRatio,
+            Uniqueness,
+        ),
+    )
+
+
+def _static_failure(analyzer, schema_info) -> Optional[BaseException]:
+    """The exception a dry-run would surface for this analyzer, determined
+    with zero data scans; None when the static pass finds no problem.
+    Conservative: only failure modes that a real run would DEFINITELY hit
+    (missing columns, wrong types, bad parameters, unparseable
+    expressions, invalid regexes) are reported — a typecheck warning like
+    a numeric comparison against a string literal does not fail a scan
+    and must not fail applicability."""
+    import re
+
+    from deequ_tpu.analyzers.base import Preconditions
+    from deequ_tpu.core.exceptions import NoSuchColumnException
+    from deequ_tpu.data.expr import ExpressionParseError, Predicate
+
+    err = Preconditions.find_first_failing(
+        schema_info.empty_table(), analyzer.preconditions()
+    )
+    if err is not None:
+        return err
+
+    for attr in ("predicate", "where"):
+        expression = getattr(analyzer, attr, None)
+        if not isinstance(expression, str):
+            continue
+        try:
+            predicate = Predicate(expression)
+        except ExpressionParseError as e:
+            return e
+        for col in predicate.referenced_columns():
+            if not schema_info.has(col):
+                return NoSuchColumnException(
+                    f"Input data does not include column {col}!"
+                )
+
+    pattern = getattr(analyzer, "pattern", None)
+    if isinstance(pattern, str):
+        try:
+            re.compile(pattern)
+        except re.error as e:
+            return e
+
+    return None
+
+
 class Applicability:
-    """reference: Applicability.scala:172-237."""
+    """reference: Applicability.scala:172-237 — but STATIC-FIRST: the
+    plan-time analyzer (deequ_tpu/lint) decides whatever it can with zero
+    scans; random data is generated and dry-run only for analyzers whose
+    failure modes statics cannot rule out."""
 
     def is_applicable(
         self, check: Check, schema: Sequence[SchemaField], num_records: int = 1000
     ) -> CheckApplicability:
-        data = generate_random_data(schema, num_records)
+        from deequ_tpu.core.exceptions import wrap_if_necessary
+        from deequ_tpu.lint import SchemaInfo
+
+        schema_info = SchemaInfo.from_schema_fields(schema)
         constraint_applicabilities: Dict[Constraint, bool] = {}
         failures: List[Tuple[str, BaseException]] = []
 
+        # static pass first; collect the constraints statics can't decide
+        dynamic: List[Tuple[Constraint, AnalysisBasedConstraint]] = []
         for constraint in check.constraints:
             inner = (
                 constraint.inner
@@ -105,11 +221,24 @@ class Applicability:
             if not isinstance(inner, AnalysisBasedConstraint):
                 constraint_applicabilities[constraint] = True
                 continue
-            metric = inner.analyzer.calculate(data)
-            ok = metric.value.is_success
-            constraint_applicabilities[constraint] = ok
-            if not ok:
-                failures.append((repr(constraint), metric.value.exception))
+            exc = _static_failure(inner.analyzer, schema_info)
+            if exc is not None:
+                constraint_applicabilities[constraint] = False
+                failures.append((repr(constraint), wrap_if_necessary(exc)))
+            elif _statically_decidable(inner.analyzer):
+                constraint_applicabilities[constraint] = True
+            else:
+                dynamic.append((constraint, inner))
+
+        # dynamic fallback only for what statics couldn't decide
+        if dynamic:
+            data = generate_random_data(schema, num_records)
+            for constraint, inner in dynamic:
+                metric = inner.analyzer.calculate(data)
+                ok = metric.value.is_success
+                constraint_applicabilities[constraint] = ok
+                if not ok:
+                    failures.append((repr(constraint), metric.value.exception))
 
         return CheckApplicability(
             not failures, failures, constraint_applicabilities
@@ -121,10 +250,23 @@ class Applicability:
         schema: Sequence[SchemaField],
         num_records: int = 1000,
     ) -> AnalyzersApplicability:
-        data = generate_random_data(schema, num_records)
+        from deequ_tpu.core.exceptions import wrap_if_necessary
+        from deequ_tpu.lint import SchemaInfo
+
+        schema_info = SchemaInfo.from_schema_fields(schema)
         failures: List[Tuple[str, BaseException]] = []
+        dynamic = []
         for analyzer in analyzers:
-            metric = analyzer.calculate(data)
-            if metric.value.is_failure:
-                failures.append((metric.instance, metric.value.exception))
+            exc = _static_failure(analyzer, schema_info)
+            if exc is not None:
+                failures.append((analyzer.instance, wrap_if_necessary(exc)))
+            elif not _statically_decidable(analyzer):
+                dynamic.append(analyzer)
+
+        if dynamic:
+            data = generate_random_data(schema, num_records)
+            for analyzer in dynamic:
+                metric = analyzer.calculate(data)
+                if metric.value.is_failure:
+                    failures.append((metric.instance, metric.value.exception))
         return AnalyzersApplicability(not failures, failures)

@@ -1,0 +1,44 @@
+"""Plan-time semantic analyzer: typed expression checking, constraint-plan
+linting, and fail-fast diagnostics — all with zero data scans.
+
+The Catalyst-analysis analogue for deequ_tpu (see README "Plan
+validation"): resolve columns, infer dtypes/nullability with Kleene
+semantics, and reject impossible plans before any kernel dispatch.
+"""
+
+from deequ_tpu.lint.diagnostics import (
+    CODES,
+    Diagnostic,
+    LintReport,
+    PlanValidationError,
+    Severity,
+)
+from deequ_tpu.lint.fold import const_fold, fold_to_constant, satisfiability
+from deequ_tpu.lint.planlint import (
+    lint_analyzer,
+    lint_expression_use,
+    lint_plan,
+    validate_plan,
+)
+from deequ_tpu.lint.schema import FieldInfo, SchemaInfo
+from deequ_tpu.lint.typecheck import TypedExpr, analyze_ast, analyze_expression
+
+__all__ = [
+    "CODES",
+    "Diagnostic",
+    "LintReport",
+    "PlanValidationError",
+    "Severity",
+    "FieldInfo",
+    "SchemaInfo",
+    "TypedExpr",
+    "analyze_ast",
+    "analyze_expression",
+    "const_fold",
+    "fold_to_constant",
+    "satisfiability",
+    "lint_analyzer",
+    "lint_expression_use",
+    "lint_plan",
+    "validate_plan",
+]

@@ -60,15 +60,35 @@ def _cache_dirs():
         yield user_dir
 
 
+def _sanitize_flags() -> list:
+    """DEEQU_TPU_SANITIZE=address,undefined adds -fsanitize instrumentation
+    to the native build (a debugging mode, not a production path: the
+    resulting .so usually needs the sanitizer runtime LD_PRELOADed into
+    the host python). Empty list when unset."""
+    spec = os.environ.get("DEEQU_TPU_SANITIZE", "").strip()
+    if not spec:
+        return []
+    sanitizers = ",".join(s.strip() for s in spec.split(",") if s.strip())
+    if not sanitizers:
+        return []
+    return [f"-fsanitize={sanitizers}", "-g", "-fno-omit-frame-pointer"]
+
+
 def _build_library() -> Optional[str]:
     """Compile the kernel; atomic tmp+rename so concurrent processes
     (the normal multihost case) never observe a half-written library.
     The output name embeds a hash of the C source, so different package
-    versions sharing a cache dir never load each other's kernels."""
+    versions sharing a cache dir never load each other's kernels; a
+    sanitized build gets its own name so it never shadows (or is
+    shadowed by) the plain one."""
     import hashlib
 
     with open(_SOURCE, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    sanitize = _sanitize_flags()
+    if sanitize:
+        tag = hashlib.sha256(" ".join(sanitize).encode()).hexdigest()[:8]
+        digest = f"{digest}_san{tag}"
     for directory in _cache_dirs():
         out = os.path.join(directory, f"_deequ_native_{digest}.so")
         if os.path.exists(out):
@@ -79,7 +99,9 @@ def _build_library() -> Optional[str]:
                 fd, tmp = tempfile.mkstemp(suffix=".so", dir=directory)
                 os.close(fd)
                 subprocess.run(
-                    [compiler, "-O3", "-shared", "-fPIC", _SOURCE, "-o", tmp],
+                    [compiler, "-O3", "-shared", "-fPIC"]
+                    + sanitize
+                    + [_SOURCE, "-o", tmp],
                     check=True,
                     capture_output=True,
                     timeout=120,

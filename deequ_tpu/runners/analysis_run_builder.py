@@ -29,6 +29,14 @@ class AnalysisRunBuilder:
         self._save_states_with: Optional["StatePersister"] = None
         self._engine: str = "auto"
         self._mesh = None
+        self._validation: Optional[str] = None
+
+    def with_plan_validation(self, mode: str) -> "AnalysisRunBuilder":
+        """Plan-time static analysis mode: "strict" raises one aggregated
+        PlanValidationError before any scan, "lenient" (default) attaches
+        diagnostics to the context, "off" skips the pass."""
+        self._validation = mode
+        return self
 
     def with_engine(self, engine: str, mesh=None) -> "AnalysisRunBuilder":
         """"auto" (mesh when >1 device), "single", or "distributed" —
@@ -83,4 +91,5 @@ class AnalysisRunBuilder:
             save_or_append_results_with_key=self._save_key,
             engine=self._engine,
             mesh=self._mesh,
+            validation=self._validation,
         )

@@ -45,9 +45,17 @@ class AnalysisRunner:
         save_or_append_results_with_key: Optional["ResultKey"] = None,
         engine: str = "auto",
         mesh=None,
+        validation: Optional[str] = None,
     ) -> AnalyzerContext:
         if not analyzers:
             return AnalyzerContext.empty()
+
+        # plan-time static analysis (see deequ_tpu/lint): strict raises
+        # before any kernel dispatch, lenient attaches diagnostics to the
+        # returned context as `validation_warnings`
+        validation_diagnostics = AnalysisRunner._validate_plan(
+            data, analyzers, validation
+        )
 
         from deequ_tpu.runners.engine import resolve_engine
 
@@ -126,6 +134,7 @@ class AnalysisRunner:
         context = (
             reused + precondition_failures + scanning_results + grouping_results
         )
+        context.validation_warnings = validation_diagnostics
 
         # 6. save (reference: AnalysisRunner.scala:182-230)
         if metrics_repository is not None and save_or_append_results_with_key is not None:
@@ -133,6 +142,26 @@ class AnalysisRunner:
                 metrics_repository, save_or_append_results_with_key, context
             )
         return context
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _validate_plan(data, analyzers, validation) -> List:
+        from deequ_tpu.lint import PlanValidationError, SchemaInfo, validate_plan
+        from deequ_tpu.lint.planlint import resolve_validation_mode
+
+        mode = resolve_validation_mode(validation)
+        if mode == "off":
+            return []
+        try:
+            schema = SchemaInfo.from_table(data)
+            report = validate_plan(
+                schema, checks=(), required_analyzers=analyzers, mode=mode
+            )
+            return list(report.diagnostics)
+        except PlanValidationError:
+            raise
+        except Exception:  # noqa: BLE001 — lint must never break a run
+            return []
 
     # ------------------------------------------------------------------
     @staticmethod

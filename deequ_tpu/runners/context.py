@@ -31,6 +31,10 @@ def sanitize_json_values(rows):
 class AnalyzerContext:
     def __init__(self, metric_map: Optional[Dict["Analyzer", Metric]] = None):
         self.metric_map: Dict["Analyzer", Metric] = dict(metric_map or {})
+        # plan-validation diagnostics attached by AnalysisRunner in
+        # lenient mode (deequ_tpu.lint.Diagnostic items); not part of
+        # equality — two contexts with the same metrics are the same
+        self.validation_warnings: List = []
 
     @staticmethod
     def empty() -> "AnalyzerContext":

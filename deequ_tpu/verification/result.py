@@ -7,7 +7,7 @@ reference: VerificationResult.scala:33-119.
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Dict, List
 
 from deequ_tpu.checks.check import Check, CheckResult, CheckStatus
@@ -23,6 +23,10 @@ class VerificationResult:
     status: CheckStatus
     check_results: Dict[Check, CheckResult]
     metrics: Dict["Analyzer", Metric]
+    # plan-validation diagnostics attached in lenient mode
+    # (deequ_tpu.lint.Diagnostic items); empty when validation is off or
+    # the plan is clean
+    validation_warnings: List = field(default_factory=list)
 
     # -- metric exporters (reference: VerificationResult.scala:40-72) --------
 

@@ -44,6 +44,7 @@ class VerificationRunBuilder:
         self._save_states_with: Optional["StatePersister"] = None
         self._engine: str = "auto"
         self._mesh = None
+        self._validation: Optional[str] = None
         self._save_check_results_json_path: Optional[str] = None
         self._save_success_metrics_json_path: Optional[str] = None
         self._overwrite_output_files = False
@@ -52,6 +53,13 @@ class VerificationRunBuilder:
         """"auto" (mesh when >1 device), "single", or "distributed"."""
         self._engine = engine
         self._mesh = mesh
+        return self
+
+    def with_plan_validation(self, mode: str) -> "VerificationRunBuilder":
+        """Plan-time static analysis mode: "strict" raises one aggregated
+        PlanValidationError before any scan, "lenient" (default) attaches
+        diagnostics to the result, "off" skips the pass."""
+        self._validation = mode
         return self
 
     def add_check(self, check: Check) -> "VerificationRunBuilder":
@@ -152,6 +160,7 @@ class VerificationRunBuilder:
             save_or_append_results_with_key=self._save_key,
             engine=self._engine,
             mesh=self._mesh,
+            validation=self._validation,
         )
         # JSON file outputs (reference: VerificationSuite.scala:146-172)
         from deequ_tpu.core.fileio import write_text_output

@@ -49,11 +49,22 @@ class VerificationSuite:
         save_or_append_results_with_key: Optional["ResultKey"] = None,
         engine: str = "auto",
         mesh=None,
+        validation: Optional[str] = None,
     ) -> VerificationResult:
-        """reference: VerificationSuite.scala:107-144."""
+        """reference: VerificationSuite.scala:107-144.
+
+        `validation` — plan-time static analysis mode: "strict" raises one
+        aggregated PlanValidationError before any kernel dispatch,
+        "lenient" (default) attaches diagnostics to the result, "off"
+        skips. Defaults to env DEEQU_TPU_VALIDATE, then lenient.
+        """
         analyzers: List[Analyzer] = list(required_analyzers)
         for check in checks:
             analyzers.extend(check.required_analyzers())
+
+        validation_diagnostics = VerificationSuite._validate_plan(
+            data, checks, required_analyzers, validation
+        )
 
         analysis_results = AnalysisRunner.do_analysis_run(
             data,
@@ -72,9 +83,13 @@ class VerificationSuite:
             save_or_append_results_with_key=None,
             engine=engine,
             mesh=mesh,
+            # the suite already validated the full plan (checks included);
+            # don't lint the bare analyzer list a second time
+            validation="off",
         )
 
         verification_result = VerificationSuite.evaluate(checks, analysis_results)
+        verification_result.validation_warnings = validation_diagnostics
 
         if metrics_repository is not None and save_or_append_results_with_key is not None:
             AnalysisRunner._save_or_append(
@@ -82,6 +97,26 @@ class VerificationSuite:
             )
 
         return verification_result
+
+    @staticmethod
+    def _validate_plan(data, checks, required_analyzers, validation) -> List:
+        """Static plan analysis before any scan. Strict mode propagates
+        the aggregated PlanValidationError; otherwise the linter must
+        never break a run — any internal failure is swallowed."""
+        from deequ_tpu.lint import PlanValidationError, SchemaInfo, validate_plan
+        from deequ_tpu.lint.planlint import resolve_validation_mode
+
+        mode = resolve_validation_mode(validation)
+        if mode == "off":
+            return []
+        try:
+            schema = SchemaInfo.from_table(data)
+            report = validate_plan(schema, checks, required_analyzers, mode=mode)
+            return list(report.diagnostics)
+        except PlanValidationError:
+            raise
+        except Exception:  # noqa: BLE001
+            return []
 
     @staticmethod
     def run_on_aggregated_states(

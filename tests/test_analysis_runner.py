@@ -2,8 +2,6 @@
 analyzers/runners/AnalysisRunnerTests.scala job-count assertions) plus
 context merge/export semantics."""
 
-import pytest
-
 from deequ_tpu.analyzers import (
     Completeness,
     Compliance,

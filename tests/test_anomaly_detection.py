@@ -1,13 +1,10 @@
 """Anomaly-detection tests (mirrors the reference's 8 pure-function test
 files incl. seasonal/HoltWintersTest)."""
 
-import math
-
 import numpy as np
 import pytest
 
 from deequ_tpu.anomaly import (
-    Anomaly,
     AnomalyDetector,
     BatchNormalStrategy,
     DataPoint,

@@ -6,7 +6,6 @@ generator's contracts (reference: analyzers/applicability/Applicability.scala)."
 from __future__ import annotations
 
 import numpy as np
-import pytest
 
 from deequ_tpu import Check, CheckLevel
 from deequ_tpu.analyzers import Completeness, Compliance, Mean, Size
@@ -65,6 +64,18 @@ class TestRandomDataGenerator:
         )
         lengths = [len(v) for v in t.column("s").values]
         assert min(lengths) >= 1 and max(lengths) <= 20
+
+    def test_decimal_precision_equals_scale(self):
+        # regression: precision == scale means zero whole digits; the
+        # generator used to call rng.integers(0.1, 1.0) and crash
+        t = generate_random_data(
+            [SchemaField("d", ColumnType.DECIMAL, nullable=False, precision=2, scale=2)],
+            500,
+            seed=5,
+        )
+        vals = t.column("d").values
+        assert np.all(vals >= 0)
+        assert np.all(vals < 1)
 
 
 class TestCheckApplicability:
@@ -132,6 +143,75 @@ class TestAnalyzersApplicability:
         assert len(result.failures) == 2
         for _instance, exception in result.failures:
             assert isinstance(exception, BaseException)
+
+
+class TestStaticFirst:
+    """The applicability checker answers statically whenever it can —
+    zero random data generated, zero scans (ISSUE 2, Layer 3)."""
+
+    def test_static_checks_never_generate_data(self, monkeypatch):
+        import deequ_tpu.applicability.applicability as mod
+
+        def boom(*args, **kwargs):
+            raise AssertionError("static-first path generated random data")
+
+        monkeypatch.setattr(mod, "generate_random_data", boom)
+        check = (
+            Check(CheckLevel.ERROR, "static")
+            .is_complete("item")
+            .has_mean("price", lambda v: True)
+            .satisfies("count > 0", "positive")
+            .is_complete("missing")  # static failure, still no scan
+        )
+        result = Applicability().is_applicable(check, SCHEMA)
+        assert not result.is_applicable
+        applicable = list(result.constraint_applicabilities.values())
+        assert applicable.count(True) == 3
+        assert applicable.count(False) == 1
+
+    def test_static_analyzers_never_generate_data(self, monkeypatch):
+        import deequ_tpu.applicability.applicability as mod
+
+        def boom(*args, **kwargs):
+            raise AssertionError("static-first path generated random data")
+
+        monkeypatch.setattr(mod, "generate_random_data", boom)
+        result = Applicability().are_applicable(
+            [Size(), Completeness("att1"), Mean("price"),
+             Compliance("c", "price > > 1")],
+            SCHEMA,
+        )
+        assert not result.is_applicable
+        assert len(result.failures) == 1
+
+    def test_udf_analyzer_falls_back_to_dynamic(self):
+        # a binning UDF can fail in ways no static pass sees — the
+        # dry-run on generated data must still run for it
+        from deequ_tpu.analyzers import Histogram
+
+        def bad_binning(value):
+            raise RuntimeError("udf exploded")
+
+        result = Applicability().are_applicable(
+            [Histogram("att1", binning_udf=bad_binning)], SCHEMA
+        )
+        assert not result.is_applicable
+        assert len(result.failures) == 1
+
+    def test_invalid_pattern_caught_statically(self, monkeypatch):
+        import deequ_tpu.applicability.applicability as mod
+        from deequ_tpu.analyzers import PatternMatch
+
+        monkeypatch.setattr(
+            mod,
+            "generate_random_data",
+            lambda *a, **k: (_ for _ in ()).throw(AssertionError("scanned")),
+        )
+        result = Applicability().are_applicable(
+            [PatternMatch("att1", "(unclosed")], SCHEMA
+        )
+        assert not result.is_applicable
+        assert len(result.failures) == 1
 
 
 class TestSuiteIntegration:

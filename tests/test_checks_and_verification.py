@@ -14,7 +14,7 @@ from deequ_tpu import (
     Table,
     VerificationSuite,
 )
-from deequ_tpu.analyzers import Completeness, Size
+from deequ_tpu.analyzers import Size
 from deequ_tpu.constraints.constraint import ConstraintStatus
 from deequ_tpu.ops import runtime
 

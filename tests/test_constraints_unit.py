@@ -7,8 +7,7 @@ from __future__ import annotations
 
 import pytest
 
-from deequ_tpu.analyzers.base import Analyzer
-from deequ_tpu.analyzers import Completeness, Size
+from deequ_tpu.analyzers import Completeness
 from deequ_tpu.constraints import constraint as C
 from deequ_tpu.constraints.constraint import (
     AnalysisBasedConstraint,
@@ -18,7 +17,6 @@ from deequ_tpu.constraints.constraint import (
 )
 from deequ_tpu.core.maybe import Failure, Success
 from deequ_tpu.core.metrics import DoubleMetric, Entity
-from deequ_tpu.data.table import Table
 from tests.fixtures import get_df_missing
 
 

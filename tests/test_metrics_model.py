@@ -5,8 +5,6 @@ context merge semantics and exporters."""
 
 from __future__ import annotations
 
-import pytest
-
 from deequ_tpu.analyzers import Completeness, Size
 from deequ_tpu.core.maybe import Failure, Success
 from deequ_tpu.core.metrics import (

@@ -1,0 +1,139 @@
+"""ASan/UBSan smoke test for the native kernels (ISSUE 2, satellite).
+
+Builds the C kernel with DEEQU_TPU_SANITIZE=address,undefined in a
+subprocess (the sanitizer runtime must be LD_PRELOADed before python
+starts, so an in-process test cannot work) and drives the batched
+multi-family kernel through it. Any heap overflow / UB the instrumented
+build detects aborts the subprocess, failing the test; environments
+without a sanitizer-capable toolchain skip.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+
+def _sanitizer_runtime():
+    """Path to libasan.so via the toolchain, or None when unavailable."""
+    for compiler in ("cc", "gcc"):
+        try:
+            out = subprocess.run(
+                [compiler, "-print-file-name=libasan.so"],
+                capture_output=True,
+                text=True,
+                timeout=30,
+            )
+        except (OSError, subprocess.SubprocessError):
+            continue
+        path = out.stdout.strip()
+        if out.returncode == 0 and os.path.isabs(path) and os.path.exists(path):
+            return path
+    return None
+
+
+_DRIVER = r"""
+import ctypes, sys
+import numpy as np
+import deequ_tpu.ops.native as native
+
+path = native._build_library()
+if path is None:
+    print("BUILD_UNAVAILABLE")
+    sys.exit(0)
+lib = native._load()
+if lib is None:
+    print("LOAD_UNAVAILABLE")
+    sys.exit(0)
+assert native.available()
+
+rng = np.random.default_rng(7)
+n = 4096
+cols = []
+for i in range(3):
+    x = rng.random(n)
+    valid = rng.random(n) > 0.05
+    cols.append((x, valid, 1, None))
+where = rng.random(n) > 0.3
+
+multi = native.masked_moments_select_multi(cols, where, cap=256)
+assert multi is not None and len(multi) == 3
+for (x, valid, _, _), (mom, samples, n_valid, level, regs) in zip(cols, multi):
+    mask = valid & where
+    ref = x[mask]
+    assert int(mom[0]) == ref.size == n_valid
+    assert abs(mom[1] - ref.sum()) < 1e-6
+    assert mom[2] == ref.min() and mom[3] == ref.max()
+    solo = native.masked_moments_select(x, valid, where, cap=256, hll_mode=1)
+    assert solo is not None
+    assert np.array_equal(solo[0], mom)
+    assert np.array_equal(solo[1], samples)
+    assert np.array_equal(solo[4], regs)
+
+# the scalar kernels too, while instrumented
+vals = rng.integers(0, 1000, n)
+packed = native.xxhash64_pack(vals, np.ones(n, dtype=bool))
+assert packed is not None and packed.shape == (n,)
+counts = native.bincount(vals.astype(np.int64), 1000)
+assert counts is not None and counts.sum() == n
+print("SANITIZED_OK")
+"""
+
+
+def test_sanitized_build_runs_clean():
+    runtime = _sanitizer_runtime()
+    if runtime is None:
+        pytest.skip("no sanitizer-capable toolchain")
+
+    with tempfile.TemporaryDirectory() as cache:
+        env = dict(os.environ)
+        env.update(
+            {
+                "DEEQU_TPU_SANITIZE": "address,undefined",
+                "DEEQU_TPU_CACHE_DIR": cache,
+                "LD_PRELOAD": runtime,
+                # python itself leaks by sanitizer standards; we only
+                # care about the kernel's memory errors, not exit leaks
+                "ASAN_OPTIONS": "detect_leaks=0,abort_on_error=1",
+                "JAX_PLATFORMS": "cpu",
+            }
+        )
+        env.pop("DEEQU_TPU_NO_NATIVE", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", _DRIVER],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        if "BUILD_UNAVAILABLE" in proc.stdout or "LOAD_UNAVAILABLE" in proc.stdout:
+            pytest.skip("sanitized native build unavailable in this environment")
+        assert proc.returncode == 0, (
+            f"sanitized run failed (rc={proc.returncode})\n"
+            f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+        )
+        assert "SANITIZED_OK" in proc.stdout
+
+
+def test_sanitize_flags_parse():
+    from deequ_tpu.ops.native import _sanitize_flags
+
+    old = os.environ.pop("DEEQU_TPU_SANITIZE", None)
+    try:
+        assert _sanitize_flags() == []
+        os.environ["DEEQU_TPU_SANITIZE"] = "address,undefined"
+        flags = _sanitize_flags()
+        assert "-fsanitize=address,undefined" in flags
+        assert "-g" in flags
+        os.environ["DEEQU_TPU_SANITIZE"] = "  "
+        assert _sanitize_flags() == []
+    finally:
+        if old is not None:
+            os.environ["DEEQU_TPU_SANITIZE"] = old
+        else:
+            os.environ.pop("DEEQU_TPU_SANITIZE", None)

@@ -35,7 +35,6 @@ from deequ_tpu.analyzers.state_provider import (
     FileSystemStateProvider,
     InMemoryStateProvider,
 )
-from deequ_tpu.data.table import Table
 from deequ_tpu.ops import runtime
 from deequ_tpu.repository import (
     FileSystemMetricsRepository,

@@ -1,0 +1,129 @@
+"""Runs the repo's own lint (tools/lint.py) as a tier-1 test, so a
+hot-loop host sync in ops/fused.py, an unused import, or a bare except
+fails the suite — not just `make lint` (ISSUE 2, satellite).
+
+Also unit-tests the checkers themselves against synthetic sources.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_module():
+    spec = importlib.util.spec_from_file_location(
+        "repo_lint", os.path.join(REPO, "tools", "lint.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_is_lint_clean(capsys):
+    lint = _lint_module()
+    rc = lint.main()
+    out = capsys.readouterr().out
+    assert rc == 0, f"repo lint found problems:\n{out}"
+
+
+def _tmp_source(code: str) -> str:
+    fd, path = tempfile.mkstemp(suffix=".py")
+    with os.fdopen(fd, "w") as f:
+        f.write(code)
+    return path
+
+
+def test_hot_loop_checker_flags_device_get_in_loop():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import jax\n"
+        "def f(batches):\n"
+        "    out = []\n"
+        "    for b in batches:\n"
+        "        out.append(jax.device_get(b))\n"
+        "    return out\n"
+    )
+    try:
+        findings = lint.check_hot_loops(path)
+        assert len(findings) == 1
+        assert "device_get" in findings[0]
+    finally:
+        os.unlink(path)
+
+
+def test_hot_loop_checker_flags_block_until_ready():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def f(xs):\n"
+        "    while xs:\n"
+        "        xs.pop().block_until_ready()\n"
+    )
+    try:
+        findings = lint.check_hot_loops(path)
+        assert len(findings) == 1
+        assert "block_until_ready" in findings[0]
+    finally:
+        os.unlink(path)
+
+
+def test_hot_loop_checker_allows_calls_outside_loops():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import jax\n"
+        "def f(out):\n"
+        "    return jax.device_get(out)\n"
+    )
+    try:
+        assert lint.check_hot_loops(path) == []
+    finally:
+        os.unlink(path)
+
+
+def test_unused_import_checker():
+    lint = _lint_module()
+    path = _tmp_source(
+        "from __future__ import annotations\n"
+        "import os\n"
+        "import sys\n"
+        "from typing import TYPE_CHECKING, List\n"
+        "x: List[int] = []\n"
+        "print(sys.argv)\n"
+    )
+    try:
+        findings = lint.check_unused_imports(path)
+        # os and TYPE_CHECKING unused; __future__, sys, List used/exempt
+        flagged = {f.split("`")[1] for f in findings}
+        assert flagged == {"os", "TYPE_CHECKING"}
+    finally:
+        os.unlink(path)
+
+
+def test_bare_except_checker():
+    lint = _lint_module()
+    path = _tmp_source(
+        "try:\n    pass\nexcept:\n    pass\n"
+        "try:\n    pass\nexcept ValueError:\n    pass\n"
+    )
+    try:
+        findings = lint.check_bare_except(path)
+        assert len(findings) == 1
+    finally:
+        os.unlink(path)
+
+
+def test_lint_main_is_invocable_as_script():
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py")],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

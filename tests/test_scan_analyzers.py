@@ -2,8 +2,6 @@
 empty / failure cases (mirrors reference analyzers/AnalyzerTests.scala and
 NullHandlingTests.scala)."""
 
-import math
-
 import numpy as np
 import pytest
 

@@ -1,7 +1,7 @@
 import numpy as np
 import pytest
 
-from deequ_tpu.core.maybe import Failure, Success, Try
+from deequ_tpu.core.maybe import Failure, Try
 from deequ_tpu.data.expr import ExpressionParseError, Predicate, eval_predicate
 from deequ_tpu.data.table import ColumnType, Table
 

@@ -1,0 +1,184 @@
+#!/usr/bin/env python3
+"""Repo lint: ruff (when installed) plus pure-AST checks that need no
+third-party tooling (ISSUE 2, satellite).
+
+Checks:
+  HOTLOOP  — no `jax.device_get(...)` / `.block_until_ready()` calls
+             inside for/while loops in deequ_tpu/ops/fused.py: a host
+             sync per iteration destroys the double-buffered pipeline
+             (each one is a full device drain).
+  F401*    — unused imports (fallback when ruff is unavailable).
+  E722*    — bare `except:` (fallback when ruff is unavailable).
+
+Exit code 0 = clean, 1 = findings. Run via `make lint` or directly:
+    python tools/lint.py
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import shutil
+import subprocess
+import sys
+from typing import Iterator, List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HOT_LOOP_FILES = [os.path.join("deequ_tpu", "ops", "fused.py")]
+HOT_LOOP_FORBIDDEN = {"device_get", "block_until_ready"}
+
+
+def _python_files() -> Iterator[str]:
+    for top in ("deequ_tpu", "tests", "tools"):
+        root = os.path.join(REPO, top)
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def _rel(path: str) -> str:
+    return os.path.relpath(path, REPO)
+
+
+# -- HOTLOOP: host syncs inside scan-loop bodies ----------------------------
+
+
+def check_hot_loops(path: str) -> List[str]:
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    findings: List[str] = []
+
+    class Visitor(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.loop_depth = 0
+
+        def _loop(self, node: ast.AST) -> None:
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        visit_For = visit_While = visit_AsyncFor = _loop
+
+        def visit_Call(self, node: ast.Call) -> None:
+            if self.loop_depth > 0 and isinstance(node.func, ast.Attribute):
+                if node.func.attr in HOT_LOOP_FORBIDDEN:
+                    findings.append(
+                        f"{_rel(path)}:{node.lineno}: HOTLOOP "
+                        f"`.{node.func.attr}` inside a loop body — each call "
+                        f"is a device drain; hoist it out of the loop"
+                    )
+            self.generic_visit(node)
+
+    Visitor().visit(tree)
+    return findings
+
+
+# -- F401 fallback: unused imports ------------------------------------------
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # forward-ref annotations ("Table"), dotted refs, __all__ entries
+            for part in node.value.replace(".", " ").replace("[", " ").replace(
+                "]", " "
+            ).split():
+                if part.isidentifier():
+                    used.add(part)
+    return used
+
+
+def check_unused_imports(path: str) -> List[str]:
+    if os.path.basename(path) == "__init__.py":
+        return []  # re-export surface: unused-looking imports are the point
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    used = _used_names(tree)
+    findings: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                bound = alias.asname or alias.name.split(".")[0]
+                if bound not in used:
+                    findings.append(
+                        f"{_rel(path)}:{node.lineno}: F401 "
+                        f"`{alias.name}` imported but unused"
+                    )
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                bound = alias.asname or alias.name
+                if bound not in used:
+                    findings.append(
+                        f"{_rel(path)}:{node.lineno}: F401 "
+                        f"`{alias.name}` imported but unused"
+                    )
+    return findings
+
+
+# -- E722 fallback: bare except ---------------------------------------------
+
+
+def check_bare_except(path: str) -> List[str]:
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    return [
+        f"{_rel(path)}:{node.lineno}: E722 bare `except:` — name the exception"
+        for node in ast.walk(tree)
+        if isinstance(node, ast.ExceptHandler) and node.type is None
+    ]
+
+
+def run_ruff() -> List[str]:
+    """Delegate F401/F821/E722 to ruff when it exists (config lives in
+    pyproject.toml); None-equivalent empty result plus a sentinel when
+    it doesn't."""
+    exe = shutil.which("ruff")
+    if exe is None:
+        return []
+    proc = subprocess.run(
+        [exe, "check", "deequ_tpu", "tests", "tools"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=300,
+    )
+    if proc.returncode == 0:
+        return []
+    return [line for line in proc.stdout.splitlines() if line.strip()]
+
+
+def main() -> int:
+    findings: List[str] = []
+
+    for rel in HOT_LOOP_FILES:
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):
+            findings.extend(check_hot_loops(path))
+
+    if shutil.which("ruff") is not None:
+        findings.extend(run_ruff())
+    else:
+        for path in _python_files():
+            findings.extend(check_unused_imports(path))
+            findings.extend(check_bare_except(path))
+
+    for line in findings:
+        print(line)
+    if findings:
+        print(f"\n{len(findings)} finding(s)")
+        return 1
+    print("lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
