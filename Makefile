@@ -1,9 +1,12 @@
 PY ?= python
 
-.PHONY: lint test test-fast
+.PHONY: lint test test-fast trace-demo
 
 lint:
 	$(PY) tools/lint.py
+
+trace-demo:
+	JAX_PLATFORMS=cpu PYTHONPATH=.:examples $(PY) examples/tracing_example.py
 
 test: lint
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q

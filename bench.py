@@ -38,6 +38,14 @@ Knobs (env):
                     timed end-to-end incl. jit compile — the methodology
                     behind BENCH_STREAM_100M/1B.json; adds rows/elapsed_s/
                     peak_rss_mb fields to the JSON line
+    BENCH_TRACE     "1" (or the --trace flag): after the timed reps, run
+                     ONE extra traced pass (deequ_tpu.observe) — adds
+                     trace_file plus a trace_phases_s breakdown
+                     (plan/dispatch/transfer/merge self-time seconds) to
+                     the JSON record. The Chrome trace itself lands at
+                     DEEQU_TPU_TRACE_OUT or a tempdir default; load it
+                     in https://ui.perfetto.dev. Shape subprocesses
+                     inherit the flag.
     BENCH_PLATFORM  force a jax platform ("cpu" | "tpu" | unset=default).
                      The JAX_PLATFORMS env var does NOT override the axon
                      TPU plugin on this box; this knob forces it in code.
@@ -540,6 +548,12 @@ def main() -> None:
     n_rows = int(os.environ.get("BENCH_ROWS", "10000000"))
     mode = os.environ.get("BENCH_MODE", "profiler")
     reps = max(1, int(os.environ.get("BENCH_TIMED", "5")))
+    trace_enabled = "--trace" in sys.argv or os.environ.get(
+        "BENCH_TRACE", ""
+    ).lower() not in ("", "0", "false")
+    if trace_enabled:
+        # shape-regression subprocesses inherit the flag through env
+        os.environ["BENCH_TRACE"] = "1"
 
     t_gen = time.perf_counter()
     if mode == "stream":
@@ -631,6 +645,31 @@ def main() -> None:
         best_cpu = min(cpu_times)
     rows_per_sec = n_rows / best
 
+    # --trace / BENCH_TRACE: one EXTRA traced pass after the timed reps
+    # (tracing never overlaps the timed loop, so the headline numbers
+    # are identical with and without it); phase self-time buckets from
+    # the span tree land in the JSON record next to the trace path
+    trace_fields = {}
+    if trace_enabled:
+        from deequ_tpu import observe
+
+        trace_out = (
+            os.environ.get(observe.ENV_OUT, "").strip()
+            or observe.default_trace_path()
+        )
+        with observe.traced_run(
+            f"bench_{mode}", enable=trace_out, rows=n_rows
+        ) as traced:
+            run(table)
+        phases = traced.trace.phase_seconds()
+        trace_fields = {
+            "trace_file": traced.trace.path,
+            "trace_phases_s": {
+                phase: round(phases.get(phase, 0.0), 4)
+                for phase in observe.PHASES
+            },
+        }
+
     peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
     if cold:
         extra = {
@@ -655,6 +694,7 @@ def main() -> None:
                 "vs_baseline": round(rows_per_sec / baseline, 3),
                 **({"cpu_s": round(best_cpu, 3)} if best_cpu is not None else {}),
                 **extra,
+                **trace_fields,
                 "pallas_onchip": pallas_onchip_check(),
             }
         )

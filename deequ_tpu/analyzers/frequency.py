@@ -224,10 +224,22 @@ def compute_frequencies(
     state merge — bounded host memory at O(#groups), never O(#rows).
     With a mesh, the count aggregation runs row-sharded on the devices
     (psum merge); the host keeps dict-encode and key bookkeeping."""
+    from deequ_tpu import observe
     from deequ_tpu.ops import runtime
 
-    runtime.record_group_pass(",".join(grouping_columns))
+    with observe.span(
+        "group_pass", cat="group", columns=",".join(grouping_columns)
+    ):
+        runtime.record_group_pass(",".join(grouping_columns))
+        return _compute_frequencies(data, grouping_columns, num_rows, mesh)
 
+
+def _compute_frequencies(
+    data: Table,
+    grouping_columns: Sequence[str],
+    num_rows: Optional[int] = None,
+    mesh=None,
+) -> FrequenciesAndNumRows:
     if hasattr(data, "with_columns"):
         data = data.with_columns(list(grouping_columns))
     if getattr(data, "is_streaming", False):

@@ -1,0 +1,73 @@
+"""The counter API: pass/launch accounting shared by `ExecutionStats`
+sinks and active tracers.
+
+`ops/runtime.py`'s `monitored()` / `record_pass()` / `record_launch()`
+delegate here (source-compatible migration, ISSUE 3 tentpole). A sink
+is any object with `device_passes` / `device_launches` / `group_passes`
+ints and a `pass_labels` list — `runtime.ExecutionStats` in practice,
+duck-typed so this module never imports the ops layer.
+
+The sink stack is thread-local (concurrent monitored scans on separate
+threads never cross-contaminate), and every record also feeds the
+thread's active tracer, whose counters therefore stay bit-identical to
+what a `monitored()` block around the same run would report.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, List
+
+from deequ_tpu.observe import spans
+
+_local = threading.local()
+
+_EMPTY: List = []
+
+
+def _sinks() -> List:
+    return getattr(_local, "sinks", _EMPTY)
+
+
+@contextlib.contextmanager
+def collect(sink) -> Iterator:
+    """Register `sink` for every record_* on this thread in the block."""
+    try:
+        stack = _local.sinks
+    except AttributeError:
+        stack = _local.sinks = []
+    stack.append(sink)
+    try:
+        yield sink
+    finally:
+        stack.pop()
+
+
+def record_pass(label: str) -> None:
+    """One fused scan over a dataset (≈ one Spark job)."""
+    for sink in _sinks():
+        sink.device_passes += 1
+        sink.pass_labels.append(label)
+    tracer = spans.current_tracer()
+    if tracer is not None:
+        tracer.count("device_passes", 1, label)
+
+
+def record_launch() -> None:
+    """One compiled-program invocation (per batch)."""
+    for sink in _sinks():
+        sink.device_launches += 1
+    tracer = spans.current_tracer()
+    if tracer is not None:
+        tracer.count("device_launches", 1)
+
+
+def record_group_pass(label: str) -> None:
+    """One group-by frequency computation."""
+    for sink in _sinks():
+        sink.group_passes += 1
+        sink.pass_labels.append(f"group:{label}")
+    tracer = spans.current_tracer()
+    if tracer is not None:
+        tracer.count("group_passes", 1, f"group:{label}")

@@ -1,0 +1,163 @@
+"""Human-readable run reports and per-phase accounting.
+
+`phase_seconds` buckets SELF time (a span's duration minus its
+children's) by span category, so the buckets are disjoint and sum to
+~the run's wall time — the per-operator accounting LaraDB
+(arXiv:1703.07342) argues fused kernels need. `render_report` draws
+the span tree with durations, categories and attributes; repeated
+siblings (per-batch dispatches, per-family kernels) aggregate into one
+`×N` line so streaming runs stay readable.
+
+Both are pure functions of the span forest — the golden test feeds
+hand-built spans with fixed times and string-compares the output.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from deequ_tpu.observe.spans import Span, Tracer
+
+# The headline buckets (always present in phase_seconds, even at 0.0):
+# fuse-group planning, kernel dispatch, device<->host transfer, state
+# merge. Other categories (native, group, scan, constraint, ...) appear
+# when spans carry them.
+PHASES = ("plan", "dispatch", "transfer", "merge")
+
+Roots = Union[Span, Tracer, Sequence[Span]]
+
+
+def _roots_of(roots: Roots) -> Sequence[Span]:
+    if isinstance(roots, Span):
+        return [roots]
+    if isinstance(roots, Tracer):
+        return roots.roots
+    return list(roots)
+
+
+def phase_seconds(roots: Roots) -> Dict[str, float]:
+    """Disjoint self-time per span category, in seconds."""
+    buckets: Dict[str, float] = {phase: 0.0 for phase in PHASES}
+
+    def visit(span: Span) -> None:
+        child_total = sum(c.duration_s for c in span.children)
+        self_time = max(span.duration_s - child_total, 0.0)
+        cat = span.cat or "other"
+        buckets[cat] = buckets.get(cat, 0.0) + self_time
+        for child in span.children:
+            visit(child)
+
+    for root in _roots_of(roots):
+        visit(root)
+    return buckets
+
+
+def _fmt_attr(value: Any) -> str:
+    if isinstance(value, float):
+        return f"{value:.4g}"
+    return str(value)
+
+
+def _attr_text(attrs: Dict[str, Any]) -> str:
+    parts = [
+        f"{key}={_fmt_attr(value)}"
+        for key, value in sorted(attrs.items())
+        if isinstance(value, (int, float, str, bool)) and key != "cpu_ms"
+    ]
+    return " ".join(parts)
+
+
+def _aggregate(children: Sequence[Span]) -> List[Tuple[Span, int, float]]:
+    """Collapse same-(name, cat) siblings: (exemplar, count, total_s)."""
+    order: List[Tuple[str, Optional[str]]] = []
+    groups: Dict[Tuple[str, Optional[str]], List[Span]] = {}
+    for child in children:
+        key = (child.name, child.cat)
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(child)
+    out = []
+    for key in order:
+        members = groups[key]
+        out.append((members[0], len(members), sum(m.duration_s for m in members)))
+    return out
+
+
+def _render_span(
+    span: Span,
+    count: int,
+    total_s: float,
+    prefix: str,
+    is_last: bool,
+    lines: List[str],
+    depth: int,
+    max_depth: int,
+) -> None:
+    connector = "└─ " if is_last else "├─ "
+    label = span.name if count == 1 else f"{span.name} ×{count}"
+    head = f"{prefix}{connector}{label}"
+    tail = f"{total_s * 1e3:9.1f} ms"
+    if span.cat:
+        tail += f"  [{span.cat}]"
+    attrs = _attr_text(span.attrs) if count == 1 else ""
+    if attrs:
+        tail += f"  {attrs}"
+    lines.append(f"{head:<44}{tail}")
+    if depth + 1 >= max_depth:
+        return
+    child_prefix = prefix + ("   " if is_last else "│  ")
+    grouped = _aggregate(span.children)
+    for i, (child, n, secs) in enumerate(grouped):
+        _render_span(
+            child,
+            n,
+            secs,
+            child_prefix,
+            i == len(grouped) - 1,
+            lines,
+            depth + 1,
+            max_depth,
+        )
+
+
+def render_report(
+    roots: Roots,
+    counters: Optional[Dict[str, int]] = None,
+    max_depth: int = 8,
+) -> str:
+    """The run report: headline counters, the (aggregated) span tree,
+    and the per-phase self-time line."""
+    root_list = _roots_of(roots)
+    if not root_list:
+        return "deequ_tpu run report — (no spans recorded)"
+    head = root_list[0]
+    wall_s = sum(r.duration_s for r in root_list)
+    cpu_s = sum(r.cpu_s for r in root_list)
+    title = head.name if len(root_list) == 1 else f"{len(root_list)} runs"
+    lines = [f"deequ_tpu run report — {title}"]
+    headline = [f"wall {wall_s * 1e3:.1f} ms", f"cpu {cpu_s * 1e3:.1f} ms"]
+    for key in ("device_passes", "device_launches", "group_passes"):
+        value = (counters or {}).get(key, head.attrs.get(key))
+        if value is not None:
+            headline.append(f"{key} {value}")
+    lines.append(" | ".join(headline))
+    for root in root_list:
+        grouped = _aggregate(root.children)
+        root_tail = f"{root.duration_s * 1e3:9.1f} ms"
+        attrs = _attr_text(root.attrs)
+        if attrs:
+            root_tail += f"  {attrs}"
+        lines.append(f"{root.name:<44}{root_tail}")
+        for i, (child, n, secs) in enumerate(grouped):
+            _render_span(
+                child, n, secs, "", i == len(grouped) - 1, lines, 1, max_depth
+            )
+    phases = phase_seconds(root_list)
+    phase_text = " | ".join(
+        f"{name} {phases[name]:.3f}s"
+        for name in sorted(phases, key=lambda k: (-phases[k], k))
+        if phases[name] > 0 or name in PHASES
+    )
+    lines.append(f"phases (self-time): {phase_text}")
+    return "\n".join(lines)

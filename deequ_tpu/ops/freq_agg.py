@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deequ_tpu import observe
 from deequ_tpu.ops import runtime
 from deequ_tpu.ops.fused import _pad_size, _to_f64
 
@@ -47,6 +48,21 @@ def run_shared_freq_agg(
     analyzers: Sequence["ScanShareableFrequencyBasedAnalyzer"],
 ) -> List[Any]:
     """One fused aggregation pass -> one metric per analyzer (in order)."""
+    spilled = bool(getattr(state, "is_spilled", False))
+    with observe.span(
+        "freq_agg",
+        cat="group",
+        analyzers=len(analyzers),
+        groups=-1 if spilled else len(getattr(state, "counts", ())),
+        spilled=spilled,
+    ):
+        return _run_shared_freq_agg(state, analyzers)
+
+
+def _run_shared_freq_agg(
+    state: "FrequenciesAndNumRows",
+    analyzers: Sequence["ScanShareableFrequencyBasedAnalyzer"],
+) -> List[Any]:
     runtime.record_pass("freq-agg:" + ",".join(a.name for a in analyzers))
     if getattr(state, "is_spilled", False):
         # disk-spilled frequencies: every freq_reduce is a sum over

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deequ_tpu import observe
 from deequ_tpu.analyzers.base import ScanShareableAnalyzer
 from deequ_tpu.analyzers.states import State
 from deequ_tpu.data.table import Table
@@ -691,19 +692,35 @@ def _precompute_family_kernels(
         group_map.setdefault((job[9], job[6], len(job[3])), []).append(idx)
     groups = list(group_map.values())
 
+    # worker-pool threads adopt the dispatching thread's trace context so
+    # family spans stay under this scan's subtree (no-op when untraced)
+    trace_tracer = observe.current_tracer()
+    trace_parent = observe.current_span()
+
     def run_group(idxs):
-        if len(idxs) > 1 and not no_multi:
-            g = [jobs[i] for i in idxs]
-            try:
-                outs = native.masked_moments_select_multi(
-                    [(j[3], j[4], j[7], j[8]) for j in g], g[0][5], g[0][6]
-                )
-            except Exception:  # noqa: BLE001
-                outs = None
-            if outs is not None:
-                return [(res, len(j[3])) for j, res in zip(g, outs)]
-            # batched kernel unavailable/failed: per-column fallback
-        return [run_one(jobs[i]) for i in idxs]
+        job0 = jobs[idxs[0]]
+        with observe.attached(trace_tracer, trace_parent), observe.span(
+            "family_kernel",
+            cat="dispatch",
+            where=str(job0[9]),
+            cap=int(job0[6]),
+            rows=len(job0[3]),
+            dtype=str(job0[3].dtype),
+            columns=len(idxs),
+            batched=len(idxs) > 1 and not no_multi,
+        ):
+            if len(idxs) > 1 and not no_multi:
+                g = [jobs[i] for i in idxs]
+                try:
+                    outs = native.masked_moments_select_multi(
+                        [(j[3], j[4], j[7], j[8]) for j in g], g[0][5], g[0][6]
+                    )
+                except Exception:  # noqa: BLE001
+                    outs = None
+                if outs is not None:
+                    return [(res, len(j[3])) for j, res in zip(g, outs)]
+                # batched kernel unavailable/failed: per-column fallback
+            return [run_one(jobs[i]) for i in idxs]
 
     if len(groups) > 1 and (os.cpu_count() or 1) > 1:
         # the C kernel releases the GIL: independent family groups run
@@ -806,32 +823,50 @@ class PipelinedAggFold:
 
     def _fold(self, pending) -> None:
         device_out, meta_box, host_ctx = pending
-        fetched = jax.device_get(device_out)
-        if meta_box is not None:
-            merge_out, assisted_out = unpack_outputs(fetched, meta_box["meta"])
-        else:
-            merge_out, assisted_out = fetched
-        batch_aggs = [_to_f64(t) for t in merge_out]
-        if self._total is None:
-            self._total = batch_aggs
-        elif batch_aggs:
-            self._total = [
-                a.merge_agg(t, b, np)
-                for a, t, b in zip(self.analyzers, self._total, batch_aggs)
-            ]
-        shifts = wire_shifts(self.sticky)
-        for i, (analyzer, out) in enumerate(zip(self.assisted, assisted_out)):
-            for d in range(self.n_dev):
-                shard = jax.tree_util.tree_map(
-                    lambda x, d=d: np.asarray(x).reshape(self.n_dev, -1)[d], out
+        with observe.span("transfer", cat="transfer") as transfer_sp:
+            fetched = jax.device_get(device_out)
+            if transfer_sp:
+                transfer_sp.set(
+                    bytes=int(
+                        sum(
+                            int(getattr(leaf, "nbytes", 0))
+                            for leaf in jax.tree_util.tree_leaves(fetched)
+                        )
+                    )
                 )
-                if host_ctx is not None and self.n_dev == 1:
-                    shard = analyzer.host_finish_batch(shard, host_ctx, shifts)
-                if shifts:
-                    shard = analyzer.unshift_batch(shard, shifts)
-                self._assisted_states[i] = analyzer.host_consume(
-                    self._assisted_states[i], shard
+        with observe.span("merge", cat="merge"):
+            if meta_box is not None:
+                merge_out, assisted_out = unpack_outputs(
+                    fetched, meta_box["meta"]
                 )
+            else:
+                merge_out, assisted_out = fetched
+            batch_aggs = [_to_f64(t) for t in merge_out]
+            if self._total is None:
+                self._total = batch_aggs
+            elif batch_aggs:
+                self._total = [
+                    a.merge_agg(t, b, np)
+                    for a, t, b in zip(self.analyzers, self._total, batch_aggs)
+                ]
+            shifts = wire_shifts(self.sticky)
+            for i, (analyzer, out) in enumerate(
+                zip(self.assisted, assisted_out)
+            ):
+                for d in range(self.n_dev):
+                    shard = jax.tree_util.tree_map(
+                        lambda x, d=d: np.asarray(x).reshape(self.n_dev, -1)[d],
+                        out,
+                    )
+                    if host_ctx is not None and self.n_dev == 1:
+                        shard = analyzer.host_finish_batch(
+                            shard, host_ctx, shifts
+                        )
+                    if shifts:
+                        shard = analyzer.unshift_batch(shard, shifts)
+                    self._assisted_states[i] = analyzer.host_consume(
+                        self._assisted_states[i], shard
+                    )
 
     def finish(self):
         if self._pending is not None:
@@ -866,9 +901,6 @@ class FusedScanPass:
         #    discrete analyzers (mask/code-only inputs) — or, below the
         #    bandwidth floor, EVERY analyzer — fold on the host inside
         #    the SAME logical scan instead of shipping rows.
-        mode = runtime.placement_mode()
-        host_all = mode == "host-all"
-        host_discrete = host_all or mode == "host-discrete"
         merge_idx: List[int] = []
         assisted_idx: List[int] = []
         host_idx: List[int] = []
@@ -877,31 +909,43 @@ class FusedScanPass:
         specs: Dict[str, Any] = {}
         device_keys: set = set()
         host_keys: Dict[int, List[str]] = {}
-        for i, analyzer in enumerate(self.analyzers):
-            try:
-                analyzer_specs = analyzer.input_specs()
-            except Exception as e:  # noqa: BLE001
-                results[i] = AnalyzerRunResult(analyzer, error=e)
-                continue
-            if getattr(analyzer, "device_assisted", False):
-                if host_all or getattr(analyzer, "host_only", False):
-                    # host_only: inputs (strings, dict codes) never ship
-                    # to the device regardless of placement
-                    host_assisted_idx.append(i)
+        with observe.span(
+            "plan_fuse", cat="plan", analyzers=len(self.analyzers)
+        ) as plan_sp:
+            mode = runtime.placement_mode()
+            host_all = mode == "host-all"
+            host_discrete = host_all or mode == "host-discrete"
+            for i, analyzer in enumerate(self.analyzers):
+                try:
+                    analyzer_specs = analyzer.input_specs()
+                except Exception as e:  # noqa: BLE001
+                    results[i] = AnalyzerRunResult(analyzer, error=e)
+                    continue
+                if getattr(analyzer, "device_assisted", False):
+                    if host_all or getattr(analyzer, "host_only", False):
+                        # host_only: inputs (strings, dict codes) never ship
+                        # to the device regardless of placement
+                        host_assisted_idx.append(i)
+                        host_keys[i] = [s.key for s in analyzer_specs]
+                    else:
+                        assisted_idx.append(i)
+                        device_keys.update(s.key for s in analyzer_specs)
+                elif host_all or (
+                    host_discrete and getattr(analyzer, "discrete_inputs", False)
+                ):
+                    host_idx.append(i)
                     host_keys[i] = [s.key for s in analyzer_specs]
                 else:
-                    assisted_idx.append(i)
+                    merge_idx.append(i)
                     device_keys.update(s.key for s in analyzer_specs)
-            elif host_all or (
-                host_discrete and getattr(analyzer, "discrete_inputs", False)
-            ):
-                host_idx.append(i)
-                host_keys[i] = [s.key for s in analyzer_specs]
-            else:
-                merge_idx.append(i)
-                device_keys.update(s.key for s in analyzer_specs)
-            for spec in analyzer_specs:
-                specs.setdefault(spec.key, spec)
+                for spec in analyzer_specs:
+                    specs.setdefault(spec.key, spec)
+            plan_sp.set(
+                placement=mode,
+                input_keys=len(specs),
+                device_members=len(merge_idx) + len(assisted_idx),
+                host_members=len(host_idx) + len(host_assisted_idx),
+            )
 
         if merge_idx or assisted_idx or host_idx or host_assisted_idx:
             table = prune_table_columns(table, specs)
@@ -910,10 +954,15 @@ class FusedScanPass:
             host_members = [(i, self.analyzers[i]) for i in host_idx]
             host_assisted = [(i, self.analyzers[i]) for i in host_assisted_idx]
             try:
-                aggs, assisted_states, host_results, device_error = self._run_pass(
-                    table, merge_analyzers, specs, assisted,
-                    device_keys, host_members, host_keys, host_assisted,
-                )
+                with observe.span(
+                    "fused_scan", cat="scan", analyzers=len(self.analyzers)
+                ):
+                    aggs, assisted_states, host_results, device_error = (
+                        self._run_pass(
+                            table, merge_analyzers, specs, assisted,
+                            device_keys, host_members, host_keys, host_assisted,
+                        )
+                    )
                 results.update(host_results)  # host outcomes stand on their own
                 if device_error is not None:
                     # a runtime failure of the shared device program fails
@@ -998,6 +1047,8 @@ class FusedScanPass:
             }
         host_assisted_states: Dict[int, Any] = {}
         family_memo: Dict[Any, Any] = {}  # cross-batch, one scan's scope
+        scanned_rows = 0
+        scanned_batches = 0
         batch_size = self.batch_size
         if (
             not use_device
@@ -1037,28 +1088,49 @@ class FusedScanPass:
                     built.materialize(key)
             if use_device and device_error is None:
                 try:
-                    for key in device_spec_keys:
-                        if key in build_errors:
-                            raise build_errors[key]
-                    padded = _pad_size(batch.num_rows, self.batch_size)
-                    packed_inputs, layout = pack_batch_inputs(
-                        [(k, built[k]) for k in device_spec_keys],
-                        padded, dtype, sticky, num_rows=batch.num_rows,
-                    )
-                    fused, meta_box = get_fused_fn(analyzers, assisted, layout)
-                    runtime.record_launch()
-                    # async dispatch: the device crunches this batch while
-                    # the host folds the previous batch (and the host
-                    # members below)
-                    fold.submit(fused(packed_inputs), meta_box, host_ctx=built)
+                    with observe.span(
+                        "dispatch", cat="dispatch", rows=batch.num_rows
+                    ) as dispatch_sp:
+                        for key in device_spec_keys:
+                            if key in build_errors:
+                                raise build_errors[key]
+                        padded = _pad_size(batch.num_rows, self.batch_size)
+                        packed_inputs, layout = pack_batch_inputs(
+                            [(k, built[k]) for k in device_spec_keys],
+                            padded, dtype, sticky, num_rows=batch.num_rows,
+                        )
+                        if dispatch_sp:
+                            dispatch_sp.set(
+                                wire_bytes=int(
+                                    sum(
+                                        int(getattr(v, "nbytes", 0))
+                                        for v in packed_inputs.values()
+                                    )
+                                )
+                            )
+                        fused, meta_box = get_fused_fn(
+                            analyzers, assisted, layout
+                        )
+                        runtime.record_launch()
+                        # async dispatch: the device crunches this batch
+                        # while the host folds the previous batch (and
+                        # the host members below)
+                        fold.submit(
+                            fused(packed_inputs), meta_box, host_ctx=built
+                        )
                 except Exception as e:  # noqa: BLE001
                     device_error = e
-            fold_host_batch(
-                built, build_errors, host_members, host_assisted,
-                host_member_keys, host_aggs, host_assisted_states, host_errors,
-                batch=batch, streaming=streaming, family_memo=family_memo,
-            )
+            with observe.span("host_fold", cat="host", rows=batch.num_rows):
+                fold_host_batch(
+                    built, build_errors, host_members, host_assisted,
+                    host_member_keys, host_aggs, host_assisted_states,
+                    host_errors, batch=batch, streaming=streaming,
+                    family_memo=family_memo,
+                )
+            scanned_rows += batch.num_rows
+            scanned_batches += 1
 
+        observe.annotate(rows=scanned_rows, batches=scanned_batches)
         aggs, assisted_states = [], []
         if device_error is None:
             try:

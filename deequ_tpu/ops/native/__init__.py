@@ -14,12 +14,35 @@ running with codegen disabled).
 from __future__ import annotations
 
 import ctypes
+import functools
 import os
 import subprocess
 import tempfile
 from typing import Optional
 
 import numpy as np
+
+from deequ_tpu import observe
+
+
+def _traced_kernel(fn):
+    """Record one `native` span per kernel invocation (size of the
+    first array argument as `n`). Disabled tracing costs one extra
+    function call + the span() thread-local probe."""
+    name = f"native:{fn.__name__}"
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        kernel_sp = observe.span(name, cat="native")
+        if not kernel_sp:
+            return fn(*args, **kwargs)
+        with kernel_sp:
+            first = args[0] if args else None
+            if hasattr(first, "__len__"):
+                kernel_sp.set(n=len(first))
+            return fn(*args, **kwargs)
+
+    return wrapper
 
 _SOURCE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "xxhash_hll.c")
 _LIB: Optional[ctypes.CDLL] = None
@@ -236,6 +259,7 @@ def available() -> bool:
     return _load() is not None
 
 
+@_traced_kernel
 def xxhash64_pack(values: np.ndarray, valid: np.ndarray) -> Optional[np.ndarray]:
     """(idx << 6 | rank) int32 per row from canonical int64 values; None
     when the native library is unavailable (caller falls back to numpy)."""
@@ -267,6 +291,7 @@ def _u8_ptr(mask: Optional[np.ndarray]):
     return mask
 
 
+@_traced_kernel
 def masked_moments(
     x: np.ndarray,
     valid: Optional[np.ndarray],
@@ -300,6 +325,7 @@ _HASHCOUNT_LOG2 = 17  # 131072 slots: load factor <= 0.5 at 65536 distinct
 _HASHCOUNT_MAX_DISTINCT = 1 << 16
 
 
+@_traced_kernel
 def hashcount(
     keys_u64: np.ndarray,
     valid: Optional[np.ndarray],
@@ -354,6 +380,7 @@ def hashcount(
     )
 
 
+@_traced_kernel
 def bincount_window(
     values: np.ndarray,
     valid: Optional[np.ndarray],
@@ -394,6 +421,7 @@ def bincount_window(
     return counts, int(meta[0]), int(meta[1])
 
 
+@_traced_kernel
 def bincount(
     codes: np.ndarray,
     nbins: int,
@@ -430,6 +458,7 @@ def bincount(
     return out
 
 
+@_traced_kernel
 def masked_select_decimate(
     x: np.ndarray,
     valid: Optional[np.ndarray],
@@ -467,6 +496,7 @@ def masked_select_decimate(
     return samples[: int(meta[2])], int(meta[0]), int(meta[1])
 
 
+@_traced_kernel
 def masked_moments_select(
     x: np.ndarray,
     valid: Optional[np.ndarray],
@@ -525,6 +555,7 @@ def masked_moments_select(
     return mom, samples[: int(meta[2])], int(meta[0]), int(meta[1]), regs
 
 
+@_traced_kernel
 def masked_moments_select_multi(
     columns,
     where: Optional[np.ndarray],
@@ -626,6 +657,7 @@ def masked_moments_select_multi(
     return out
 
 
+@_traced_kernel
 def hll_update_registers(
     packed: np.ndarray, where: Optional[np.ndarray], registers: np.ndarray
 ) -> bool:

@@ -10,7 +10,6 @@ property (SURVEY.md §6 efficiency invariants).
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Iterator, List, Optional
@@ -18,6 +17,9 @@ from typing import Iterator, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from deequ_tpu.observe import counters as _counters
+from deequ_tpu.observe.spans import timed_call as _timed
 
 
 def compute_dtype() -> jnp.dtype:
@@ -117,12 +119,6 @@ def measure_device_bandwidth(nbytes: int = 4 << 20, iters: int = 3) -> float:
     for _ in range(iters - 1):
         best = min(best, _timed(lambda: float(total(data))))
     return nbytes / max(best - dispatch, 1e-9)
-
-
-def _timed(fn) -> float:
-    start = time.monotonic()
-    fn()
-    return time.monotonic() - start
 
 
 def placement_mode() -> str:
@@ -288,41 +284,29 @@ class ExecutionStats:
         return self.device_passes + self.group_passes
 
 
-_local = threading.local()
-
-
-def _stack() -> List[ExecutionStats]:
-    if not hasattr(_local, "stack"):
-        _local.stack = []
-    return _local.stack
-
-
 @contextlib.contextmanager
 def monitored() -> Iterator[ExecutionStats]:
-    """Collect engine-execution counts for everything run inside the block."""
+    """Collect engine-execution counts for everything run inside the block.
+
+    Counting itself lives in `deequ_tpu.observe.counters` (thread-local
+    sink stack, shared with the tracing subsystem so span pass-count
+    attributes stay bit-identical to these stats); this wrapper keeps
+    the historical `runtime.monitored()` surface."""
     stats = ExecutionStats()
-    _stack().append(stats)
-    try:
+    with _counters.collect(stats):
         yield stats
-    finally:
-        _stack().pop()
 
 
 def record_pass(label: str) -> None:
-    for stats in _stack():
-        stats.device_passes += 1
-        stats.pass_labels.append(label)
+    _counters.record_pass(label)
 
 
 def record_launch() -> None:
-    for stats in _stack():
-        stats.device_launches += 1
+    _counters.record_launch()
 
 
 def record_group_pass(label: str) -> None:
-    for stats in _stack():
-        stats.group_passes += 1
-        stats.pass_labels.append(f"group:{label}")
+    _counters.record_group_pass(label)
 
 
 def pad_to(arr: np.ndarray, size: int) -> np.ndarray:

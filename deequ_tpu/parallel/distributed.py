@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deequ_tpu import observe
 from deequ_tpu.analyzers.base import ScanShareableAnalyzer
 from deequ_tpu.data.table import Table
 from deequ_tpu.ops import runtime
@@ -154,6 +155,15 @@ class DistributedScanPass:
         self.batch_size_per_device = batch_size_per_device
 
     def run(self, table: Table) -> List[AnalyzerRunResult]:
+        with observe.span(
+            "dist_scan",
+            cat="scan",
+            devices=int(self.mesh.shape[self.axis_name]),
+            analyzers=len(self.analyzers),
+        ):
+            return self._run(table)
+
+    def _run(self, table: Table) -> List[AnalyzerRunResult]:
         # same placement policy as FusedScanPass: on a slow device link,
         # discrete (mask/code-only) analyzers — or under 'host-all',
         # every analyzer — fold on the host while the mesh reduces the rest
@@ -249,54 +259,74 @@ class DistributedScanPass:
                         built.materialize(key)
                 if fn is not None and device_error is None:
                     try:
-                        for key in device_keys:
-                            if key in build_errors:
-                                raise build_errors[key]
-                        # pad to a multiple of n_devices (pow2 per shard)
-                        per_dev = _pad_size(
-                            -(-batch.num_rows // n_devices),
-                            self.batch_size_per_device,
-                        )
-                        padded = per_dev * n_devices
-                        inputs: Dict[str, Any] = {}
-                        for key in device_keys:
-                            arr = runtime.pad_to(built[key], padded)
-                            if np.issubdtype(arr.dtype, np.integer):
-                                arr = runtime.narrow_int_wire(
-                                    arr, key, sticky
-                                )
-                            elif arr.dtype != np.bool_:
-                                if (
-                                    np.dtype(dtype) == np.float32
-                                    and key.startswith("num:")
-                                ):
-                                    # same f32 pre-centering as
-                                    # pack_batch_inputs (see fused.py)
-                                    from deequ_tpu.ops.fused import (
-                                        resolve_shift,
+                        with observe.span(
+                            "dispatch",
+                            cat="dispatch",
+                            rows=batch.num_rows,
+                            devices=int(n_devices),
+                        ) as dispatch_sp:
+                            for key in device_keys:
+                                if key in build_errors:
+                                    raise build_errors[key]
+                            # pad to a multiple of n_devices (pow2 per shard)
+                            per_dev = _pad_size(
+                                -(-batch.num_rows // n_devices),
+                                self.batch_size_per_device,
+                            )
+                            padded = per_dev * n_devices
+                            inputs: Dict[str, Any] = {}
+                            for key in device_keys:
+                                arr = runtime.pad_to(built[key], padded)
+                                if np.issubdtype(arr.dtype, np.integer):
+                                    arr = runtime.narrow_int_wire(
+                                        arr, key, sticky
                                     )
-
-                                    shift = resolve_shift(
-                                        key, arr, sticky, built.get
-                                    )
-                                    if shift != 0.0:
-                                        arr = (
-                                            np.asarray(arr, dtype=np.float64)
-                                            - shift
+                                elif arr.dtype != np.bool_:
+                                    if (
+                                        np.dtype(dtype) == np.float32
+                                        and key.startswith("num:")
+                                    ):
+                                        # same f32 pre-centering as
+                                        # pack_batch_inputs (see fused.py)
+                                        from deequ_tpu.ops.fused import (
+                                            resolve_shift,
                                         )
-                                arr = arr.astype(dtype)
-                            inputs[key] = jax.device_put(arr, in_sharding[key])
-                        runtime.record_launch()
-                        fold.submit(fn(inputs))
+
+                                        shift = resolve_shift(
+                                            key, arr, sticky, built.get
+                                        )
+                                        if shift != 0.0:
+                                            arr = (
+                                                np.asarray(
+                                                    arr, dtype=np.float64
+                                                )
+                                                - shift
+                                            )
+                                    arr = arr.astype(dtype)
+                                inputs[key] = jax.device_put(
+                                    arr, in_sharding[key]
+                                )
+                            if dispatch_sp:
+                                dispatch_sp.set(
+                                    wire_bytes=sum(
+                                        int(getattr(v, "nbytes", 0))
+                                        for v in inputs.values()
+                                    )
+                                )
+                            runtime.record_launch()
+                            fold.submit(fn(inputs))
                     except Exception as e:  # noqa: BLE001
                         device_error = e
-                fold_host_batch(
-                    built, build_errors, host_members, host_assisted,
-                    host_member_keys, host_aggs, host_assisted_states,
-                    host_errors,
-                    batch=batch, streaming=streaming,
-                    family_memo=family_memo,
-                )
+                with observe.span(
+                    "host_fold", cat="host", rows=batch.num_rows
+                ):
+                    fold_host_batch(
+                        built, build_errors, host_members, host_assisted,
+                        host_member_keys, host_aggs, host_assisted_states,
+                        host_errors,
+                        batch=batch, streaming=streaming,
+                        family_memo=family_memo,
+                    )
             aggs, assisted_states = [], []
             if device_error is None:
                 try:
@@ -379,9 +409,16 @@ def sharded_bincount(
             )
         )
         _BINCOUNT_CACHE[key] = fn
-    runtime.record_launch()
-    sharding = NamedSharding(mesh, P(axis_name))
-    counts = np.asarray(fn(jax.device_put(full, sharding)))
+    with observe.span(
+        "group_bincount",
+        cat="dispatch",
+        rows=len(codes),
+        bins=nbins,
+        devices=int(n_devices),
+    ):
+        runtime.record_launch()
+        sharding = NamedSharding(mesh, P(axis_name))
+        counts = np.asarray(fn(jax.device_put(full, sharding)))
     return counts[:nbins].astype(np.int64)
 
 

@@ -37,6 +37,7 @@ from typing import List, Optional, Sequence
 import jax
 import numpy as np
 
+from deequ_tpu import observe
 from deequ_tpu.analyzers.base import Analyzer
 from deequ_tpu.analyzers.state_provider import (
     InMemoryStateProvider,
@@ -169,7 +170,32 @@ def merge_states_across_hosts(
         parts.append(payload)
     envelope = b"".join(parts)
 
-    for host_envelope in gather(envelope):
+    with observe.span(
+        "state_allgather",
+        cat="transfer",
+        analyzers=len(analyzers),
+        envelope_bytes=len(envelope),
+    ):
+        host_envelopes = gather(envelope)
+
+    with observe.span(
+        "state_merge",
+        cat="merge",
+        analyzers=len(analyzers),
+        hosts=len(host_envelopes),
+    ):
+        _merge_host_envelopes(
+            analyzers, host_envelopes, digest, merged, errors
+        )
+    return merged, errors
+
+
+def _merge_host_envelopes(analyzers, host_envelopes, digest, merged, errors):
+    """Decode each host's tagged envelope positionally and semigroup-fold
+    states into `merged` (first failure per analyzer wins in `errors`)."""
+    import struct
+
+    for host_envelope in host_envelopes:
         if host_envelope[:8] != digest:
             raise ValueError(
                 "multihost analyzer-list mismatch: a host sent a state "
@@ -191,7 +217,6 @@ def merge_states_across_hosts(
             other = deserialize_state(analyzer, body)
             prev = merged.load(analyzer)
             merged.persist(analyzer, other if prev is None else prev.merge(other))
-    return merged, errors
 
 
 def run_multihost_analysis(

@@ -30,6 +30,16 @@ class AnalysisRunBuilder:
         self._engine: str = "auto"
         self._mesh = None
         self._validation: Optional[str] = None
+        self._tracing = None
+
+    def with_tracing(self, trace=True) -> "AnalysisRunBuilder":
+        """Run observability (deequ_tpu.observe): True records a
+        hierarchical span tree attached as `context.run_trace`; a str
+        additionally writes the Chrome-trace JSON to that path (load in
+        Perfetto); False forces tracing off regardless of the
+        DEEQU_TPU_TRACE env knob."""
+        self._tracing = trace
+        return self
 
     def with_plan_validation(self, mode: str) -> "AnalysisRunBuilder":
         """Plan-time static analysis mode: "strict" raises one aggregated
@@ -92,4 +102,5 @@ class AnalysisRunBuilder:
             engine=self._engine,
             mesh=self._mesh,
             validation=self._validation,
+            tracing=self._tracing,
         )

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import TYPE_CHECKING, Dict, List, Optional, Sequence
 
+from deequ_tpu import observe
 from deequ_tpu.analyzers.base import Analyzer, Preconditions, ScanShareableAnalyzer
 from deequ_tpu.core.metrics import Metric
 from deequ_tpu.data.table import Table
@@ -46,16 +47,58 @@ class AnalysisRunner:
         engine: str = "auto",
         mesh=None,
         validation: Optional[str] = None,
+        tracing=None,
     ) -> AnalyzerContext:
         if not analyzers:
             return AnalyzerContext.empty()
 
+        # `tracing`: True/False/an output path/None (= the
+        # DEEQU_TPU_TRACE env knob). The finished RunTrace attaches to
+        # the returned context as `run_trace` (the validation_warnings
+        # pattern); nested under a traced verification run this becomes
+        # a child subtree of the suite's trace.
+        with observe.traced_run(
+            "analysis_run", enable=tracing, analyzers=len(analyzers)
+        ) as run:
+            context = AnalysisRunner._do_analysis_run(
+                data,
+                analyzers,
+                aggregate_with,
+                save_states_with,
+                metrics_repository,
+                reuse_existing_results_for_key,
+                fail_if_results_missing,
+                save_or_append_results_with_key,
+                engine,
+                mesh,
+                validation,
+            )
+        if run:
+            context.run_trace = run.trace
+        return context
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _do_analysis_run(
+        data: Table,
+        analyzers: Sequence[Analyzer],
+        aggregate_with: Optional["StateLoader"] = None,
+        save_states_with: Optional["StatePersister"] = None,
+        metrics_repository: Optional["MetricsRepository"] = None,
+        reuse_existing_results_for_key: Optional["ResultKey"] = None,
+        fail_if_results_missing: bool = False,
+        save_or_append_results_with_key: Optional["ResultKey"] = None,
+        engine: str = "auto",
+        mesh=None,
+        validation: Optional[str] = None,
+    ) -> AnalyzerContext:
         # plan-time static analysis (see deequ_tpu/lint): strict raises
         # before any kernel dispatch, lenient attaches diagnostics to the
         # returned context as `validation_warnings`
-        validation_diagnostics = AnalysisRunner._validate_plan(
-            data, analyzers, validation
-        )
+        with observe.span("plan_validate", cat="plan"):
+            validation_diagnostics = AnalysisRunner._validate_plan(
+                data, analyzers, validation
+            )
 
         from deequ_tpu.runners.engine import resolve_engine
 
@@ -228,14 +271,20 @@ class AnalysisRunner:
                 failure_map[a] = a.to_failure_metric(err)
 
         aggregated = InMemoryStateProvider()
-        for analyzer in passed:
-            for loader in state_loaders:
-                state = loader.load(analyzer)
-                if state is None:
-                    continue
-                existing = aggregated.load(analyzer)
-                merged = existing.merge(state) if existing is not None else state
-                aggregated.persist(analyzer, merged)
+        with observe.span(
+            "state_merge", cat="merge",
+            analyzers=len(passed), loaders=len(state_loaders),
+        ):
+            for analyzer in passed:
+                for loader in state_loaders:
+                    state = loader.load(analyzer)
+                    if state is None:
+                        continue
+                    existing = aggregated.load(analyzer)
+                    merged = (
+                        existing.merge(state) if existing is not None else state
+                    )
+                    aggregated.persist(analyzer, merged)
 
         metrics: Dict[Analyzer, Metric] = dict(failure_map)
         for analyzer in passed:

@@ -35,6 +35,9 @@ class AnalyzerContext:
         # lenient mode (deequ_tpu.lint.Diagnostic items); not part of
         # equality — two contexts with the same metrics are the same
         self.validation_warnings: List = []
+        # observability: the run's RunTrace (deequ_tpu.observe) when
+        # tracing was enabled, else None; also excluded from equality
+        self.run_trace = None
 
     @staticmethod
     def empty() -> "AnalyzerContext":

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
 
+from deequ_tpu import observe
 from deequ_tpu.core.metrics import Metric
 from deequ_tpu.data.table import Table
 from deequ_tpu.runners.context import AnalyzerContext
@@ -49,43 +50,69 @@ def run_grouping_analyzers(
         groups.setdefault(tuple(sorted(analyzer.grouping_columns())), []).append(analyzer)
 
     for cols, group in groups.items():
-        try:
-            shared_state = compute_frequencies(data, list(cols), mesh=mesh)
-        except Exception as e:  # noqa: BLE001
-            for analyzer in group:
-                metrics[analyzer] = analyzer.to_failure_metric(e)
-            continue
-
-        if aggregate_with is not None or save_states_with is not None:
-            # per-analyzer state merge/persist takes priority over fusion
-            for analyzer in group:
-                try:
-                    metrics[analyzer] = analyzer.calculate_metric(
-                        shared_state, aggregate_with, save_states_with
-                    )
-                except Exception as e:  # noqa: BLE001
-                    metrics[analyzer] = analyzer.to_failure_metric(e)
-            continue
-
-        shareable = [
-            a for a in group if isinstance(a, ScanShareableFrequencyBasedAnalyzer)
-        ]
-        non_shareable = [
-            a for a in group if not isinstance(a, ScanShareableFrequencyBasedAnalyzer)
-        ]
-        if shareable:
-            try:
-                for analyzer, metric in zip(
-                    shareable, run_shared_freq_agg(shared_state, shareable)
-                ):
-                    metrics[analyzer] = metric
-            except Exception as e:  # noqa: BLE001
-                for analyzer in shareable:
-                    metrics[analyzer] = analyzer.to_failure_metric(e)
-        for analyzer in non_shareable:  # e.g. MutualInformation: extra pass
-            try:
-                metrics[analyzer] = analyzer.compute_metric_from(shared_state)
-            except Exception as e:  # noqa: BLE001
-                metrics[analyzer] = analyzer.to_failure_metric(e)
+        with observe.span(
+            "grouping", cat="group",
+            columns=",".join(cols), analyzers=len(group),
+        ):
+            _run_column_set(
+                data, cols, group, metrics,
+                aggregate_with, save_states_with, mesh,
+                compute_frequencies, ScanShareableFrequencyBasedAnalyzer,
+                run_shared_freq_agg,
+            )
 
     return AnalyzerContext(metrics)
+
+
+def _run_column_set(
+    data,
+    cols,
+    group,
+    metrics,
+    aggregate_with,
+    save_states_with,
+    mesh,
+    compute_frequencies,
+    ScanShareableFrequencyBasedAnalyzer,
+    run_shared_freq_agg,
+) -> None:
+    """One grouping-column set: a shared frequency pass, then either
+    per-analyzer state handling or the fused aggregation."""
+    try:
+        shared_state = compute_frequencies(data, list(cols), mesh=mesh)
+    except Exception as e:  # noqa: BLE001
+        for analyzer in group:
+            metrics[analyzer] = analyzer.to_failure_metric(e)
+        return
+
+    if aggregate_with is not None or save_states_with is not None:
+        # per-analyzer state merge/persist takes priority over fusion
+        for analyzer in group:
+            try:
+                metrics[analyzer] = analyzer.calculate_metric(
+                    shared_state, aggregate_with, save_states_with
+                )
+            except Exception as e:  # noqa: BLE001
+                metrics[analyzer] = analyzer.to_failure_metric(e)
+        return
+
+    shareable = [
+        a for a in group if isinstance(a, ScanShareableFrequencyBasedAnalyzer)
+    ]
+    non_shareable = [
+        a for a in group if not isinstance(a, ScanShareableFrequencyBasedAnalyzer)
+    ]
+    if shareable:
+        try:
+            for analyzer, metric in zip(
+                shareable, run_shared_freq_agg(shared_state, shareable)
+            ):
+                metrics[analyzer] = metric
+        except Exception as e:  # noqa: BLE001
+            for analyzer in shareable:
+                metrics[analyzer] = analyzer.to_failure_metric(e)
+    for analyzer in non_shareable:  # e.g. MutualInformation: extra pass
+        try:
+            metrics[analyzer] = analyzer.compute_metric_from(shared_state)
+        except Exception as e:  # noqa: BLE001
+            metrics[analyzer] = analyzer.to_failure_metric(e)

@@ -27,6 +27,9 @@ class VerificationResult:
     # (deequ_tpu.lint.Diagnostic items); empty when validation is off or
     # the plan is clean
     validation_warnings: List = field(default_factory=list)
+    # observability: the run's RunTrace (deequ_tpu.observe) when tracing
+    # was enabled via with_tracing(...) or DEEQU_TPU_TRACE, else None
+    run_trace: object = None
 
     # -- metric exporters (reference: VerificationResult.scala:40-72) --------
 

@@ -45,6 +45,7 @@ class VerificationRunBuilder:
         self._engine: str = "auto"
         self._mesh = None
         self._validation: Optional[str] = None
+        self._tracing = None
         self._save_check_results_json_path: Optional[str] = None
         self._save_success_metrics_json_path: Optional[str] = None
         self._overwrite_output_files = False
@@ -60,6 +61,16 @@ class VerificationRunBuilder:
         PlanValidationError before any scan, "lenient" (default) attaches
         diagnostics to the result, "off" skips the pass."""
         self._validation = mode
+        return self
+
+    def with_tracing(self, trace=True) -> "VerificationRunBuilder":
+        """Run observability (deequ_tpu.observe): True records a
+        hierarchical span tree (plan / dispatch / transfer / merge /
+        constraint eval) attached as `result.run_trace`; a str
+        additionally writes the Chrome-trace JSON to that path (load in
+        Perfetto); False forces tracing off regardless of the
+        DEEQU_TPU_TRACE env knob."""
+        self._tracing = trace
         return self
 
     def add_check(self, check: Check) -> "VerificationRunBuilder":
@@ -161,6 +172,7 @@ class VerificationRunBuilder:
             engine=self._engine,
             mesh=self._mesh,
             validation=self._validation,
+            tracing=self._tracing,
         )
         # JSON file outputs (reference: VerificationSuite.scala:146-172)
         from deequ_tpu.core.fileio import write_text_output

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from typing import TYPE_CHECKING, Dict, List, Optional, Sequence
 
+from deequ_tpu import observe
 from deequ_tpu.analyzers.base import Analyzer
 from deequ_tpu.checks.check import Check, CheckResult, CheckStatus
 from deequ_tpu.runners.analysis_runner import AnalysisRunner
@@ -50,6 +51,7 @@ class VerificationSuite:
         engine: str = "auto",
         mesh=None,
         validation: Optional[str] = None,
+        tracing=None,
     ) -> VerificationResult:
         """reference: VerificationSuite.scala:107-144.
 
@@ -57,44 +59,62 @@ class VerificationSuite:
         aggregated PlanValidationError before any kernel dispatch,
         "lenient" (default) attaches diagnostics to the result, "off"
         skips. Defaults to env DEEQU_TPU_VALIDATE, then lenient.
+
+        `tracing` — run observability (deequ_tpu.observe): True records
+        a span tree, a str additionally names the Chrome-trace output
+        path, None defers to the DEEQU_TPU_TRACE env knob, False forces
+        off. The finished trace attaches as `result.run_trace`.
         """
-        analyzers: List[Analyzer] = list(required_analyzers)
-        for check in checks:
-            analyzers.extend(check.required_analyzers())
+        with observe.traced_run(
+            "verification_suite", enable=tracing, checks=len(checks)
+        ) as run:
+            analyzers: List[Analyzer] = list(required_analyzers)
+            for check in checks:
+                analyzers.extend(check.required_analyzers())
 
-        validation_diagnostics = VerificationSuite._validate_plan(
-            data, checks, required_analyzers, validation
-        )
+            with observe.span("plan_validate", cat="plan"):
+                validation_diagnostics = VerificationSuite._validate_plan(
+                    data, checks, required_analyzers, validation
+                )
 
-        analysis_results = AnalysisRunner.do_analysis_run(
-            data,
-            analyzers,
-            aggregate_with=aggregate_with,
-            save_states_with=save_states_with,
-            metrics_repository=metrics_repository,
-            reuse_existing_results_for_key=reuse_existing_results_for_key,
-            fail_if_results_missing=fail_if_results_missing,
-            # NOT forwarded: results are saved AFTER check evaluation, so
-            # anomaly-check assertions querying the repository see only
-            # prior history, not this run's own metrics
-            # (reference: VerificationSuite.scala:121-139 passes
-            # saveOrAppendResultsWithKey = None into the runner and saves
-            # post-evaluate)
-            save_or_append_results_with_key=None,
-            engine=engine,
-            mesh=mesh,
-            # the suite already validated the full plan (checks included);
-            # don't lint the bare analyzer list a second time
-            validation="off",
-        )
-
-        verification_result = VerificationSuite.evaluate(checks, analysis_results)
-        verification_result.validation_warnings = validation_diagnostics
-
-        if metrics_repository is not None and save_or_append_results_with_key is not None:
-            AnalysisRunner._save_or_append(
-                metrics_repository, save_or_append_results_with_key, analysis_results
+            analysis_results = AnalysisRunner.do_analysis_run(
+                data,
+                analyzers,
+                aggregate_with=aggregate_with,
+                save_states_with=save_states_with,
+                metrics_repository=metrics_repository,
+                reuse_existing_results_for_key=reuse_existing_results_for_key,
+                fail_if_results_missing=fail_if_results_missing,
+                # NOT forwarded: results are saved AFTER check evaluation, so
+                # anomaly-check assertions querying the repository see only
+                # prior history, not this run's own metrics
+                # (reference: VerificationSuite.scala:121-139 passes
+                # saveOrAppendResultsWithKey = None into the runner and saves
+                # post-evaluate)
+                save_or_append_results_with_key=None,
+                engine=engine,
+                mesh=mesh,
+                # the suite already validated the full plan (checks included);
+                # don't lint the bare analyzer list a second time
+                validation="off",
             )
+
+            verification_result = VerificationSuite.evaluate(
+                checks, analysis_results
+            )
+            verification_result.validation_warnings = validation_diagnostics
+
+            if (
+                metrics_repository is not None
+                and save_or_append_results_with_key is not None
+            ):
+                AnalysisRunner._save_or_append(
+                    metrics_repository,
+                    save_or_append_results_with_key,
+                    analysis_results,
+                )
+        if run:
+            verification_result.run_trace = run.trace
 
         return verification_result
 
@@ -164,9 +184,12 @@ class VerificationSuite:
     ) -> VerificationResult:
         """reference: VerificationSuite.scala:263-281 — overall status is
         the max severity over check statuses."""
-        check_results: Dict[Check, CheckResult] = {
-            check: check.evaluate(analysis_context) for check in checks
-        }
+        with observe.span(
+            "constraint_eval", cat="constraint", checks=len(checks)
+        ):
+            check_results: Dict[Check, CheckResult] = {
+                check: check.evaluate(analysis_context) for check in checks
+            }
         if check_results:
             status = max(
                 (r.status for r in check_results.values()), key=lambda s: s.severity

@@ -1,0 +1,56 @@
+"""Observability demo: trace a verification run, print the run report,
+and write a Chrome-trace JSON you can load at https://ui.perfetto.dev.
+
+Run directly or via `make trace-demo`.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+from deequ_tpu import Check, CheckLevel, VerificationSuite
+from deequ_tpu.data.table import Table
+
+
+def main() -> None:
+    rng = np.random.default_rng(42)
+    n = 500_000
+    data = Table.from_numpy(
+        {
+            "price": rng.lognormal(3.0, 1.0, n),
+            "quantity": rng.integers(1, 50, n).astype(np.float64),
+            "discount": rng.random(n) * 0.3,
+            "in_stock": rng.random(n) < 0.9,
+        }
+    )
+
+    trace_path = os.path.join(tempfile.gettempdir(), "deequ_tpu_demo_trace.json")
+    result = (
+        VerificationSuite()
+        .on_data(data)
+        .add_check(
+            Check(CheckLevel.ERROR, "inventory sanity")
+            .is_complete("price")
+            .is_non_negative("price")
+            .has_min("quantity", lambda v: v >= 1.0)
+            .has_max("discount", lambda v: v <= 0.3)
+        )
+        .with_tracing(trace_path)  # or DEEQU_TPU_TRACE=1 in the env
+        .run()
+    )
+
+    trace = result.run_trace
+    print(trace.report())
+    print()
+    phases = trace.phase_seconds()
+    print(
+        "phase breakdown:",
+        ", ".join(f"{k}={phases[k] * 1e3:.1f}ms" for k in sorted(phases)),
+    )
+    print(f"chrome trace written to: {trace.path}")
+    print("load it in https://ui.perfetto.dev (or chrome://tracing)")
+
+
+if __name__ == "__main__":
+    main()

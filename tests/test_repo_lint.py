@@ -84,6 +84,56 @@ def test_hot_loop_checker_allows_calls_outside_loops():
         os.unlink(path)
 
 
+def test_timing_checker_flags_clock_reads():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import time\n"
+        "from time import monotonic as mono\n"
+        "def f():\n"
+        "    t0 = time.perf_counter()\n"
+        "    t1 = mono()\n"
+        "    return time.perf_counter_ns() - t0 + t1\n"
+    )
+    try:
+        findings = lint.check_timing_calls(path)
+        assert len(findings) == 3
+        assert any("time.perf_counter" in f for f in findings)
+        assert any("mono" in f for f in findings)
+    finally:
+        os.unlink(path)
+
+
+def test_timing_checker_allows_wall_clock_and_observe():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import time\n"
+        "from deequ_tpu.observe.spans import timed_call\n"
+        "def f(fn):\n"
+        "    ts = time.time()  # wall-clock timestamps (TTL caches) ok\n"
+        "    out, dt = timed_call(fn)\n"
+        "    return ts, out, dt\n"
+    )
+    try:
+        assert lint.check_timing_calls(path) == []
+    finally:
+        os.unlink(path)
+
+
+def test_timing_rule_scopes_to_engine_dirs():
+    """The ban covers deequ_tpu/runners + deequ_tpu/ops only — observe/
+    (the timing implementation itself) and bench.py stay free to read
+    clocks directly."""
+    lint = _lint_module()
+    sep = os.sep
+    covered = f"deequ_tpu{sep}ops{sep}runtime.py"
+    exempt = f"deequ_tpu{sep}observe{sep}spans.py"
+    in_scope = lambda rel: any(  # noqa: E731 - mirror of main()'s filter
+        rel == d or rel.startswith(d + sep) for d in lint.TIMING_DIRS
+    )
+    assert in_scope(covered)
+    assert not in_scope(exempt)
+
+
 def test_unused_import_checker():
     lint = _lint_module()
     path = _tmp_source(

@@ -7,6 +7,12 @@ Checks:
              inside for/while loops in deequ_tpu/ops/fused.py: a host
              sync per iteration destroys the double-buffered pipeline
              (each one is a full device drain).
+  TIMING   — no direct `time.perf_counter()` / `time.monotonic()` (or
+             their `_ns` variants) in deequ_tpu/runners/ and
+             deequ_tpu/ops/: engine timing must flow through
+             deequ_tpu.observe (span()/timed_call()) so traces stay the
+             single source of runtime truth and the disabled path keeps
+             its measured near-zero overhead.
   F401*    — unused imports (fallback when ruff is unavailable).
   E722*    — bare `except:` (fallback when ruff is unavailable).
 
@@ -26,6 +32,17 @@ from typing import Iterator, List
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HOT_LOOP_FILES = [os.path.join("deequ_tpu", "ops", "fused.py")]
 HOT_LOOP_FORBIDDEN = {"device_get", "block_until_ready"}
+# Engine dirs where ad-hoc clock reads are banned (observe/ owns timing).
+TIMING_DIRS = (
+    os.path.join("deequ_tpu", "runners"),
+    os.path.join("deequ_tpu", "ops"),
+)
+TIMING_FORBIDDEN = {
+    "perf_counter",
+    "perf_counter_ns",
+    "monotonic",
+    "monotonic_ns",
+}
 
 
 def _python_files() -> Iterator[str]:
@@ -72,6 +89,47 @@ def check_hot_loops(path: str) -> List[str]:
             self.generic_visit(node)
 
     Visitor().visit(tree)
+    return findings
+
+
+# -- TIMING: ad-hoc clock reads in engine code -------------------------------
+
+
+def check_timing_calls(path: str) -> List[str]:
+    """Flag `time.perf_counter()`/`time.monotonic()` (and `_ns`) calls —
+    direct or via `from time import ...` — in engine dirs. Timing there
+    belongs to deequ_tpu.observe: `span(...)` for traced regions,
+    `timed_call(...)` for one-off measurements."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    # names bound by `from time import perf_counter [as x]`
+    local_clocks = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name in TIMING_FORBIDDEN:
+                    local_clocks.add(alias.asname or alias.name)
+    findings: List[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        hit = None
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in TIMING_FORBIDDEN
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "time"
+        ):
+            hit = f"time.{func.attr}"
+        elif isinstance(func, ast.Name) and func.id in local_clocks:
+            hit = func.id
+        if hit is not None:
+            findings.append(
+                f"{_rel(path)}:{node.lineno}: TIMING `{hit}()` in engine "
+                f"code — use deequ_tpu.observe (span()/timed_call()) so "
+                f"the measurement lands in the trace"
+            )
     return findings
 
 
@@ -163,6 +221,13 @@ def main() -> int:
         path = os.path.join(REPO, rel)
         if os.path.exists(path):
             findings.extend(check_hot_loops(path))
+
+    for path in _python_files():
+        rel = _rel(path)
+        if any(
+            rel == d or rel.startswith(d + os.sep) for d in TIMING_DIRS
+        ):
+            findings.extend(check_timing_calls(path))
 
     if shutil.which("ruff") is not None:
         findings.extend(run_ruff())
