@@ -1,9 +1,26 @@
 PY ?= python
 
-.PHONY: lint test test-fast trace-demo
+.PHONY: lint typecheck analyze test test-fast trace-demo
 
 lint:
 	$(PY) tools/lint.py
+
+# mypy strict on the typed core (deequ_tpu/lint, deequ_tpu/observe —
+# see [tool.mypy] in pyproject.toml), permissive elsewhere. Degrades to
+# a notice when mypy is not installed: the repo must stay checkable in
+# environments that cannot add packages.
+typecheck:
+	@if $(PY) -c "import mypy" 2>/dev/null; then \
+		$(PY) -m mypy deequ_tpu/lint deequ_tpu/observe; \
+	else \
+		echo "typecheck: mypy not installed — skipping (pip install mypy to enable)"; \
+	fi
+
+# the full static-analysis suite: repo lints, types, and a smoke
+# EXPLAIN over the benchmark plan (proves the cost analyzer runs
+# end-to-end without touching data)
+analyze: lint typecheck
+	JAX_PLATFORMS=cpu $(PY) tools/explain_bench.py
 
 trace-demo:
 	JAX_PLATFORMS=cpu PYTHONPATH=.:examples $(PY) examples/tracing_example.py

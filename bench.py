@@ -214,9 +214,10 @@ def run_profiler(table):
     return ColumnProfiler.profile(table)
 
 
-def run_scan(table):
-    """BASELINE.json config 2: fused scalar scan (Mean/StdDev/Min/Max +
-    friends) on numeric columns — one pass."""
+def scan_analyzers():
+    """The BASELINE.json config-2 analyzer plan, exposed so `make
+    analyze` (tools/explain_bench.py) can EXPLAIN the exact plan the
+    benchmark executes."""
     from deequ_tpu.analyzers import (
         ApproxCountDistinct,
         Completeness,
@@ -227,9 +228,8 @@ def run_scan(table):
         StandardDeviation,
         Sum,
     )
-    from deequ_tpu.ops.fused import FusedScanPass
 
-    analyzers = [
+    return [
         Size(),
         Completeness("price"),
         Mean("price"),
@@ -241,7 +241,14 @@ def run_scan(table):
         Mean("discount"),
         StandardDeviation("discount"),
     ]
-    results = FusedScanPass(analyzers).run(table)
+
+
+def run_scan(table):
+    """BASELINE.json config 2: fused scalar scan (Mean/StdDev/Min/Max +
+    friends) on numeric columns — one pass."""
+    from deequ_tpu.ops.fused import FusedScanPass
+
+    results = FusedScanPass(scan_analyzers()).run(table)
     for r in results:
         r.state_or_raise()
     return results

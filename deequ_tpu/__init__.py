@@ -29,6 +29,7 @@ from deequ_tpu.checks.check import Check, CheckLevel, CheckStatus
 from deequ_tpu.verification.suite import VerificationSuite
 from deequ_tpu.verification.result import VerificationResult
 from deequ_tpu.constraints.constrainable_data_types import ConstrainableDataTypes
+from deequ_tpu.lint.explain import explain_plan
 
 __version__ = "0.1.0"
 
@@ -52,4 +53,5 @@ __all__ = [
     "VerificationSuite",
     "VerificationResult",
     "ConstrainableDataTypes",
+    "explain_plan",
 ]

@@ -6,12 +6,26 @@ validation"): resolve columns, infer dtypes/nullability with Kleene
 semantics, and reject impossible plans before any kernel dispatch.
 """
 
+from deequ_tpu.lint.cost import (
+    FamilyGroupCost,
+    PassCost,
+    PlanCost,
+    analyze_plan,
+)
 from deequ_tpu.lint.diagnostics import (
     CODES,
     Diagnostic,
     LintReport,
     PlanValidationError,
     Severity,
+)
+from deequ_tpu.lint.effects import AnalyzerEffect, scan_effects
+from deequ_tpu.lint.explain import (
+    ExplainResult,
+    cost_diagnostics,
+    explain,
+    explain_plan,
+    render_explain,
 )
 from deequ_tpu.lint.fold import const_fold, fold_to_constant, satisfiability
 from deequ_tpu.lint.planlint import (
@@ -41,4 +55,15 @@ __all__ = [
     "lint_expression_use",
     "lint_plan",
     "validate_plan",
+    "AnalyzerEffect",
+    "ExplainResult",
+    "FamilyGroupCost",
+    "PassCost",
+    "PlanCost",
+    "analyze_plan",
+    "cost_diagnostics",
+    "explain",
+    "explain_plan",
+    "render_explain",
+    "scan_effects",
 ]

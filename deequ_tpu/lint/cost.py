@@ -1,0 +1,490 @@
+"""Static scan-cost analyzer: predict the execution shape of an analysis
+plan — passes, fused family groups, batches, wire bytes, transfers —
+WITHOUT touching a row of data.
+
+The predictions are not estimates of a separate model: placement
+partitioning, input-spec dedup, and family grouping come from the SAME
+pure planner the runtime consumes (`ops/fused.plan_scan_members` /
+`plan_family_jobs` / `group_family_jobs`), and the batching/wire math
+replays `FusedScanPass._run_pass` / `pack_batch_inputs` arithmetic. The
+trace-differential suite (tests/test_trace_differential.py) pins the
+predicted dispatch signature against the observed `RunTrace` span tree,
+so the model cannot silently drift from execution.
+
+Stated model assumptions (where runtime behavior is data-dependent):
+
+  * bool where/predicate masks are transferred (the runtime elides a
+    mask that happens to be all-true on a given batch);
+  * the counts-family shortcut is off (DEEQU_TPU_NO_COUNTS_FASTPATH=1
+    in the differential suite);
+  * the shared freq aggregation stays on host (group count below the
+    device threshold) unless a cardinality hint says otherwise.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deequ_tpu.lint.effects import (
+    AnalyzerEffect,
+    _MASK_PREFIXES,
+    analyzer_read_columns,
+    pass_read_bytes_per_row,
+    pass_wire_bytes_per_row,
+    prednn_elided,
+    scan_effects,
+)
+from deequ_tpu.lint.schema import SchemaInfo
+
+#: every span name the execution layer can emit for one analysis run;
+#: `span_counts` carries an entry for each (0 = predicted absent) so the
+#: differential suite compares complete vocabularies, not subsets.
+EXECUTION_SPANS = (
+    "plan_fuse",
+    "fused_scan",
+    "dist_scan",
+    "dispatch",
+    "host_fold",
+    "transfer",
+    "merge",
+    "family_kernel",
+    "grouping",
+    "group_pass",
+    "freq_agg",
+    "state_allgather",
+)
+
+#: counter names `runtime` records that the model predicts
+COUNTERS = ("device_passes", "device_launches", "group_passes")
+
+
+@dataclass(frozen=True)
+class FamilyGroupCost:
+    """One predicted family-kernel dispatch group: the (where, cap)
+    batch of quantile-family columns a single native traversal serves
+    per scan batch. Mirrors the `family_kernel` span attrs."""
+
+    where: str  # where_key of the family ("where:<all>" for no filter)
+    cap: int
+    dtype: str  # compute dtype of the value arrays
+    columns: Tuple[str, ...]
+    batched: bool
+    want_regs: bool = False
+
+
+@dataclass
+class PassCost:
+    """Predicted cost of ONE pass over the data (a fused scan, one
+    grouping-column-set frequency pass, or a solo analyzer's own scan)."""
+
+    kind: str  # 'scan' | 'grouping' | 'aux'
+    label: str
+    analyzers: Tuple[str, ...] = ()
+    columns: Tuple[str, ...] = ()
+    device_members: int = 0
+    host_members: int = 0
+    input_keys: Tuple[str, ...] = ()
+    read_bytes_per_row: float = 0.0
+    wire_bytes_per_row: float = 0.0
+    n_batches: int = 1
+    #: exact packed wire bytes of the FIRST batch (replays the
+    #: `pack_batch_inputs` layout math); None when the key set contains
+    #: a data-dependent format (e.g. range-narrowed int codes)
+    wire_bytes_per_batch: Optional[int] = None
+    family_groups: Tuple[FamilyGroupCost, ...] = ()
+    #: grouping passes: estimated distinct-group count (product of
+    #: `approx_distinct` hints); None when any hint is missing
+    estimated_groups: Optional[int] = None
+    spill_risk: bool = False
+    notes: Tuple[str, ...] = ()
+
+
+@dataclass
+class PlanCost:
+    """Machine-readable prediction of a plan's execution shape."""
+
+    placement: str
+    compute_dtype: str
+    engine: str
+    num_rows: Optional[int]
+    batch_size: Optional[int]
+    analyzers: Tuple[str, ...] = ()  # post-dedupe, pre-precondition
+    precondition_failures: Tuple[Tuple[str, str], ...] = ()
+    effects: Tuple[AnalyzerEffect, ...] = ()
+    passes: List[PassCost] = field(default_factory=list)
+    counters: Dict[str, int] = field(default_factory=dict)
+    span_counts: Dict[str, int] = field(default_factory=dict)
+    num_hosts: int = 1
+    allgather_rounds: int = 0
+
+    @property
+    def total_read_bytes_per_row(self) -> float:
+        return sum(p.read_bytes_per_row for p in self.passes)
+
+    @property
+    def total_wire_bytes_per_row(self) -> float:
+        return sum(p.wire_bytes_per_row for p in self.passes)
+
+    @property
+    def scan_pass(self) -> Optional[PassCost]:
+        for p in self.passes:
+            if p.kind == "scan":
+                return p
+        return None
+
+    def dispatch_signature(self) -> Dict[str, Any]:
+        """The comparable execution shape: counters, span histogram, and
+        the deduplicated family-group set — exactly what
+        `observe.compare.dispatch_signature(trace)` extracts from a real
+        run's trace."""
+        families = sorted(
+            (g.where, g.cap, g.dtype, g.columns, g.batched)
+            for p in self.passes
+            for g in p.family_groups
+        )
+        return {
+            "counters": dict(self.counters),
+            "spans": {k: v for k, v in self.span_counts.items() if v},
+            "family_groups": families,
+        }
+
+
+# -- wire-format replay -------------------------------------------------------
+
+
+def _predict_packed_bytes(
+    device_keys: Sequence[str],
+    schema: SchemaInfo,
+    rows: int,
+    batch_size: int,
+    compute_itemsize: int,
+) -> Optional[int]:
+    """Replay `pack_batch_inputs` byte accounting for one batch of
+    `rows` rows. Returns None when a key's wire format is data-dependent
+    (runtime range-narrowing) and therefore not statically exact."""
+    from deequ_tpu.ops.fused import _pad_size
+
+    padded = _pad_size(rows, batch_size)
+    total = 0
+    any_const = False
+    for key in device_keys:
+        if key == "where:<all>":
+            any_const = True
+        elif key.startswith("valid:"):
+            fld = schema.field(key[len("valid:") :])
+            if fld is not None and not fld.nullable:
+                any_const = True  # all-true mask: synthesized on device
+            else:
+                total += padded // 8
+        elif key.startswith("prednn:") and prednn_elided(
+            key[len("prednn:") :], schema
+        ):
+            any_const = True
+        elif key.startswith(_MASK_PREFIXES):
+            total += padded // 8
+        elif key.startswith("num:"):
+            total += padded * compute_itemsize
+        elif key.startswith("dtclass:"):
+            total += padded  # int8 codes; narrow_int_wire keeps int8
+        else:
+            return None  # e.g. hll: hash codes — narrowing is data-dependent
+    if any_const:
+        total += 4  # the int32[1] `__nrows` scalar
+    return total
+
+
+def _n_batches(num_rows: Optional[int], batch_size: int) -> int:
+    if num_rows is None:
+        return 1
+    return max(1, math.ceil(num_rows / batch_size))
+
+
+def _quantile_cap(analyzer: Any) -> Optional[int]:
+    sample_size = getattr(analyzer, "_sample_size", None)
+    if callable(sample_size):
+        try:
+            return int(sample_size())
+        except Exception:  # noqa: BLE001
+            return None
+    return None
+
+
+# -- the analyzer -------------------------------------------------------------
+
+
+def analyze_plan(
+    analyzers: Sequence[Any],
+    schema: SchemaInfo,
+    *,
+    num_rows: Optional[int] = None,
+    batch_size: Optional[int] = None,
+    placement: Optional[str] = None,
+    engine: str = "single",
+    num_hosts: int = 1,
+    num_devices: int = 1,
+) -> PlanCost:
+    """Abstract interpretation of `AnalysisRunner._do_analysis_run`:
+    dedupe -> static precondition filtering (zero-row table) ->
+    grouping/scanning split -> the pure scan planner -> batching and
+    wire math. Pure: no kernel is compiled, no row is read."""
+    from deequ_tpu.analyzers.base import Preconditions, ScanShareableAnalyzer
+    from deequ_tpu.analyzers.frequency import (
+        FrequencyBasedAnalyzer,
+        ScanShareableFrequencyBasedAnalyzer,
+    )
+    from deequ_tpu.analyzers.freq_spill import default_max_groups_in_memory
+    from deequ_tpu.analyzers.grouping import GroupingAnalyzer
+    from deequ_tpu.ops import runtime
+    from deequ_tpu.ops.fused import (
+        DEFAULT_BATCH_SIZE,
+        group_family_jobs,
+        plan_family_jobs,
+    )
+    from deequ_tpu.ops.freq_agg import _DEVICE_THRESHOLD
+
+    compute_dtype = np.dtype(runtime.compute_dtype())
+    itemsize = int(compute_dtype.itemsize)
+
+    # dedupe preserving order — same identity the runner uses
+    seen: set = set()
+    unique: List[Any] = []
+    for a in analyzers:
+        if a not in seen:
+            seen.add(a)
+            unique.append(a)
+
+    # static precondition replay on the zero-row schema table
+    empty = schema.empty_table()
+    passed: List[Any] = []
+    failures: List[Tuple[str, str]] = []
+    for a in unique:
+        try:
+            err = Preconditions.find_first_failing(empty, a.preconditions())
+        except Exception as e:  # noqa: BLE001
+            err = e
+        if err is None:
+            passed.append(a)
+        else:
+            failures.append((repr(a), f"{type(err).__name__}: {err}"))
+
+    grouping = [a for a in passed if isinstance(a, GroupingAnalyzer)]
+    scanning = [a for a in passed if not isinstance(a, GroupingAnalyzer)]
+    shareable = [a for a in scanning if isinstance(a, ScanShareableAnalyzer)]
+    solo = [a for a in scanning if not isinstance(a, ScanShareableAnalyzer)]
+
+    cost = PlanCost(
+        placement=placement or runtime.placement_mode(),
+        compute_dtype=compute_dtype.name,
+        engine=engine,
+        num_rows=num_rows,
+        batch_size=batch_size,
+        analyzers=tuple(repr(a) for a in unique),
+        precondition_failures=tuple(failures),
+        num_hosts=max(1, int(num_hosts)),
+        counters={k: 0 for k in COUNTERS},
+        span_counts={k: 0 for k in EXECUTION_SPANS},
+    )
+    spans = cost.span_counts
+    counters = cost.counters
+    distributed = engine == "distributed"
+
+    # ---- the fused scan pass ------------------------------------------------
+    if shareable:
+        plan, effects = scan_effects(shareable, mode=cost.placement)
+        cost.effects = tuple(effects)
+        use_device = bool(plan.merge_idx or plan.assisted_idx)
+
+        if distributed:
+            eff_batch = (batch_size or (1 << 21)) * max(1, int(num_devices))
+        else:
+            eff_batch = batch_size or DEFAULT_BATCH_SIZE
+            if not use_device and batch_size is None and num_rows is not None:
+                # pure host fold over an in-memory table widens to one
+                # batch (FusedScanPass._run_pass host-widening rule)
+                eff_batch = max(eff_batch, min(num_rows, 1 << 24))
+        batches = _n_batches(num_rows, eff_batch)
+
+        device_keys = sorted(plan.device_keys)
+        scan_columns: List[str] = []
+        for eff in effects:
+            for c in eff.columns:
+                if c not in scan_columns:
+                    scan_columns.append(c)
+
+        host_assisted_members = [shareable[i] for i in plan.host_assisted_idx]
+        host_only_members = [shareable[i] for i in plan.host_idx]
+        jobs = plan_family_jobs(host_assisted_members, host_only_members)
+        groups = group_family_jobs(jobs)
+        family_groups = tuple(
+            FamilyGroupCost(
+                where=key[0],
+                cap=key[1],
+                # family kernels consume `numeric_values()` host arrays,
+                # which are float64 regardless of the device dtype
+                dtype="float64",
+                columns=tuple(j.column for j in grp),
+                batched=len(grp) > 1,
+                want_regs=any(j.want_regs for j in grp),
+            )
+            for key, grp in groups
+        )
+
+        first_rows = (
+            min(num_rows, eff_batch) if num_rows is not None else eff_batch
+        )
+        wire_exact = (
+            _predict_packed_bytes(
+                device_keys, schema, first_rows, eff_batch, itemsize
+            )
+            if use_device
+            else 0
+        )
+
+        notes: List[str] = []
+        if plan.spec_errors:
+            notes.append(f"{len(plan.spec_errors)} member(s) fail at spec build")
+        scan_pass = PassCost(
+            kind="scan",
+            label="fused scan",
+            analyzers=tuple(repr(a) for a in shareable),
+            columns=tuple(scan_columns),
+            device_members=plan.device_member_count,
+            host_members=plan.host_member_count,
+            input_keys=tuple(device_keys),
+            read_bytes_per_row=pass_read_bytes_per_row(scan_columns, schema),
+            wire_bytes_per_row=(
+                pass_wire_bytes_per_row(device_keys, schema, itemsize)
+                if use_device
+                else 0.0
+            ),
+            n_batches=batches,
+            wire_bytes_per_batch=wire_exact,
+            family_groups=family_groups,
+            notes=tuple(notes),
+        )
+        cost.passes.append(scan_pass)
+
+        if plan.any_members:
+            counters["device_passes"] += 1
+            spans["host_fold"] += batches
+            if distributed:
+                spans["dist_scan"] += 1
+            else:
+                spans["fused_scan"] += 1
+            if use_device:
+                counters["device_launches"] += batches
+                spans["dispatch"] += batches
+                spans["transfer"] += batches
+                spans["merge"] += batches
+            spans["family_kernel"] += len(groups) * batches
+        if not distributed:
+            spans["plan_fuse"] += 1
+        if cost.num_hosts > 1 and plan.any_members:
+            cost.allgather_rounds = 1
+            spans["state_allgather"] += 1
+
+    # ---- solo scanning analyzers (their own pass each) ----------------------
+    for a in solo:
+        cols = analyzer_read_columns(a)
+        cost.passes.append(
+            PassCost(
+                kind="aux",
+                label=f"solo scan: {getattr(a, 'name', type(a).__name__)}",
+                analyzers=(repr(a),),
+                columns=cols,
+                read_bytes_per_row=pass_read_bytes_per_row(cols, schema),
+                n_batches=1,
+                notes=("runs outside the shared pass",),
+            )
+        )
+        # Histogram's vectorized group pass records a group_pass counter
+        if getattr(a, "name", "") == "Histogram":
+            counters["group_passes"] += 1
+
+    # ---- grouping passes (one frequency pass per column set) ----------------
+    freq_based = [a for a in grouping if isinstance(a, FrequencyBasedAnalyzer)]
+    other_grouping = [
+        a for a in grouping if not isinstance(a, FrequencyBasedAnalyzer)
+    ]
+    sets: Dict[Tuple[str, ...], List[Any]] = {}
+    for a in freq_based:
+        sets.setdefault(tuple(sorted(a.grouping_columns())), []).append(a)
+
+    max_groups = default_max_groups_in_memory()
+    for cols, group in sets.items():
+        est: Optional[int] = 1
+        for c in cols:
+            fld = schema.field(c)
+            if fld is None or fld.approx_distinct is None:
+                est = None
+                break
+            est *= max(1, int(fld.approx_distinct))
+        freq_shareable = [
+            a for a in group if isinstance(a, ScanShareableFrequencyBasedAnalyzer)
+        ]
+        freq_solo = [
+            a
+            for a in group
+            if not isinstance(a, ScanShareableFrequencyBasedAnalyzer)
+        ]
+        notes = []
+        spill = est is not None and est > max_groups
+        if spill:
+            notes.append(
+                f"~{est} groups exceeds the in-memory budget ({max_groups}): "
+                "the frequency state will spill to disk"
+            )
+        cost.passes.append(
+            PassCost(
+                kind="grouping",
+                label=f"grouping pass over ({', '.join(cols)})",
+                analyzers=tuple(repr(a) for a in group),
+                columns=cols,
+                read_bytes_per_row=pass_read_bytes_per_row(cols, schema),
+                n_batches=1,
+                estimated_groups=est,
+                spill_risk=spill,
+                notes=tuple(notes),
+            )
+        )
+        spans["grouping"] += 1
+        spans["group_pass"] += 1
+        counters["group_passes"] += 1
+        if freq_shareable:
+            spans["freq_agg"] += 1
+            counters["device_passes"] += 1
+            # spilled states stream on host; only an in-memory counts
+            # array at/above the device threshold launches a kernel
+            if est is not None and est >= _DEVICE_THRESHOLD and not spill:
+                counters["device_launches"] += 1
+        # non-shareable frequency analyzers (e.g. MutualInformation)
+        # each take an extra aggregation pass over the counts
+        counters["device_passes"] += len(freq_solo)
+
+    for a in other_grouping:
+        cols = analyzer_read_columns(a)
+        cost.passes.append(
+            PassCost(
+                kind="aux",
+                label=f"grouping (own pass): {getattr(a, 'name', type(a).__name__)}",
+                analyzers=(repr(a),),
+                columns=cols,
+                read_bytes_per_row=pass_read_bytes_per_row(cols, schema),
+            )
+        )
+
+    return cost
+
+
+__all__ = [
+    "COUNTERS",
+    "EXECUTION_SPANS",
+    "FamilyGroupCost",
+    "PassCost",
+    "PlanCost",
+    "analyze_plan",
+]

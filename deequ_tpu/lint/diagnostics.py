@@ -39,6 +39,12 @@ CODES = {
     "DQ204": "unsatisfiable predicate",
     "DQ205": "constant-foldable predicate",
     "DQ206": "fusion-breaking where-clause formatting",
+    # performance diagnostics (static cost analyzer, lint/cost.py)
+    "DQ300": "redundant analyzer scan covered by the shared pass",
+    "DQ301": "fusion-splitting equivalent where-clauses",
+    "DQ302": "cap/cardinality blowup",
+    "DQ303": "per-pass working set exceeds the cache-tile budget",
+    "DQ304": "transfer-per-row anti-pattern",
 }
 
 
@@ -83,6 +89,9 @@ class LintReport:
     """All diagnostics from one plan validation pass."""
 
     diagnostics: List[Diagnostic] = field(default_factory=list)
+    # machine-readable cost prediction (lint/cost.PlanCost) when the
+    # validation pass ran the static cost analyzer; None otherwise
+    plan_cost: Optional[object] = None
 
     @property
     def errors(self) -> List[Diagnostic]:

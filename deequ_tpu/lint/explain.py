@@ -1,0 +1,369 @@
+"""EXPLAIN for analysis plans: the human-readable report over the
+static cost model (lint/cost.py) plus the DQ300-DQ304 performance
+diagnostics.
+
+`explain_plan(data_or_schema, analyzers=..., checks=...)` is the public
+entrypoint: it predicts the execution shape (passes, batches, wire
+bytes, family groups) without scanning a row, lints the plan for
+performance anti-patterns, and renders both as a report. The same
+diagnostics feed `validate_plan` when a row-count is known, so strict
+runs aggregate DQ3xx warnings next to DQ1xx/DQ2xx errors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from deequ_tpu.data.expr import (
+    Bin,
+    ExpressionParseError,
+    Un,
+    normalize_expression,
+    parse,
+)
+from deequ_tpu.lint.cost import PassCost, PlanCost, analyze_plan, _quantile_cap
+from deequ_tpu.lint.diagnostics import Diagnostic, Severity
+from deequ_tpu.lint.fold import satisfiability
+from deequ_tpu.lint.schema import SchemaInfo
+
+#: DQ302: a quantile sketch cap at/above this many sample slots per
+#: (column, where) family dominates the scan's host working set
+DQ302_CAP_LIMIT = 1 << 20
+
+#: DQ303: native family kernels tile the scan in SD_MC_BLOCK=4096-row
+#: blocks; one tile's working set (values + valid + mask bytes per
+#: column) above this budget thrashes L2 and serializes the multi-column
+#: batch. ~1 MiB: half a typical per-core L2.
+DQ303_TILE_ROWS = 4096
+DQ303_TILE_BUDGET_BYTES = 1 << 20
+
+#: DQ304: an explicit batch size below this floor with more than this
+#: many batches pays per-dispatch latency per handful of rows
+DQ304_MIN_BATCH = 1 << 16
+DQ304_MAX_BATCHES = 8
+
+_MAX_PAIRWISE_WHERES = 32
+
+
+def _implied(a: Any, b: Any, schema: Optional[SchemaInfo]) -> bool:
+    """True when predicate `a` admits no TRUE row that `b` excludes —
+    i.e. the filter masks agree on every row (Kleene: NULL rows are
+    excluded by both sides already)."""
+    verdict = satisfiability(Bin("and", a, Un("not", b)), schema)
+    return verdict in ("unsat", "null-only")
+
+
+def cost_diagnostics(
+    cost: PlanCost,
+    analyzers: Sequence[Any] = (),
+    schema: Optional[SchemaInfo] = None,
+) -> List[Diagnostic]:
+    """The DQ300-DQ304 performance lints over a computed `PlanCost`."""
+    diags: List[Diagnostic] = []
+    scan = cost.scan_pass
+    scan_columns = set(scan.columns) if scan is not None else set()
+
+    # DQ300 — a solo-pass analyzer re-reads columns the shared scan
+    # already covers: its work could ride the fused pass
+    if scan is not None and scan_columns:
+        for p in cost.passes:
+            if p.kind != "aux" or not p.columns:
+                continue
+            if set(p.columns) <= scan_columns:
+                diags.append(
+                    Diagnostic(
+                        "DQ300",
+                        Severity.WARNING,
+                        f"{p.label} re-reads column(s) "
+                        f"{', '.join(sorted(p.columns))} that the shared "
+                        "scan pass already reads — an extra full pass "
+                        "over data the plan touches anyway",
+                        subject=p.analyzers[0] if p.analyzers else None,
+                    )
+                )
+
+    # DQ301 — where-clauses that are provably equivalent but normalize
+    # differently: they split the fused (where, cap) family groups and
+    # duplicate mask inputs, where one spelling would share both
+    by_norm: Dict[str, Tuple[str, Any]] = {}
+    for analyzer in analyzers:
+        where = getattr(analyzer, "where", None)
+        if not isinstance(where, str):
+            continue
+        try:
+            key = normalize_expression(where)
+            ast = parse(where)
+        except ExpressionParseError:
+            continue
+        by_norm.setdefault(key, (where, ast))
+    norms = list(by_norm.items())
+    if 1 < len(norms) <= _MAX_PAIRWISE_WHERES:
+        for i in range(len(norms)):
+            for j in range(i + 1, len(norms)):
+                (_, (ti, ai)), (_, (tj, aj)) = norms[i], norms[j]
+                if _implied(ai, aj, schema) and _implied(aj, ai, schema):
+                    diags.append(
+                        Diagnostic(
+                            "DQ301",
+                            Severity.WARNING,
+                            f"where-clauses {ti!r} and {tj!r} are "
+                            "semantically equivalent but spelled "
+                            "differently: they transfer two masks and "
+                            "split one fused family group into two "
+                            "kernel dispatches",
+                            suggestion=ti,
+                        )
+                    )
+
+    # DQ302 — blowup: an extreme quantile cap, or a grouping pass whose
+    # estimated cardinality exceeds the in-memory group budget
+    for analyzer in analyzers:
+        cap = _quantile_cap(analyzer)
+        if cap is not None and cap >= DQ302_CAP_LIMIT:
+            diags.append(
+                Diagnostic(
+                    "DQ302",
+                    Severity.WARNING,
+                    f"quantile sketch cap {cap} (from relative_error="
+                    f"{getattr(analyzer, 'relative_error', '?')}) holds "
+                    f"{cap} sample slots per (column, where) family — "
+                    "the sketch stops being a sketch; relax "
+                    "relative_error",
+                    subject=repr(analyzer),
+                )
+            )
+    for p in cost.passes:
+        if p.kind == "grouping" and p.spill_risk:
+            diags.append(
+                Diagnostic(
+                    "DQ302",
+                    Severity.WARNING,
+                    f"grouping over ({', '.join(p.columns)}) is estimated "
+                    f"at ~{p.estimated_groups} groups — beyond the "
+                    "in-memory budget; the frequency state will spill to "
+                    "disk partition by partition",
+                )
+            )
+
+    # DQ303 — one family-kernel group's cache tile outgrows the budget:
+    # too many columns batched into one (where, cap) traversal
+    if scan is not None:
+        itemsize = 8 if cost.compute_dtype == "float64" else 4
+        for g in scan.family_groups:
+            tile = DQ303_TILE_ROWS * (len(g.columns) * (itemsize + 1) + 1)
+            if tile > DQ303_TILE_BUDGET_BYTES:
+                diags.append(
+                    Diagnostic(
+                        "DQ303",
+                        Severity.WARNING,
+                        f"family group (where={g.where!r}, cap={g.cap}) "
+                        f"batches {len(g.columns)} columns: one "
+                        f"{DQ303_TILE_ROWS}-row tile needs ~{tile} bytes, "
+                        f"over the {DQ303_TILE_BUDGET_BYTES}-byte cache "
+                        "budget — split the plan or the where groups",
+                    )
+                )
+
+    # DQ304 — transfer-per-row anti-pattern: a tiny explicit batch size
+    # turns one streaming scan into many per-dispatch round-trips
+    if (
+        scan is not None
+        and scan.device_members > 0
+        and cost.batch_size is not None
+        and cost.batch_size < DQ304_MIN_BATCH
+        and scan.n_batches > DQ304_MAX_BATCHES
+    ):
+        diags.append(
+            Diagnostic(
+                "DQ304",
+                Severity.WARNING,
+                f"batch_size={cost.batch_size} dispatches "
+                f"{scan.n_batches} device round-trips for this row "
+                "count; below ~65536 rows/batch the per-dispatch "
+                "latency dominates the wire time — raise batch_size",
+            )
+        )
+    return diags
+
+
+# -- rendering ----------------------------------------------------------------
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "?"
+    value = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if value < 1024 or unit == "GiB":
+            return f"{value:.1f} {unit}" if unit != "B" else f"{int(value)} B"
+        value /= 1024
+    return f"{value:.1f} GiB"
+
+
+def _render_pass(p: PassCost, idx: int) -> List[str]:
+    lines = [f"Pass {idx}: {p.label}  [{p.kind}]"]
+    if p.analyzers:
+        lines.append(f"  members: {len(p.analyzers)} "
+                     f"(device {p.device_members}, host {p.host_members})"
+                     if p.kind == "scan" else f"  members: {len(p.analyzers)}")
+    if p.columns:
+        lines.append(f"  reads: {', '.join(p.columns)} "
+                     f"(~{p.read_bytes_per_row:g} B/row)")
+    if p.input_keys:
+        lines.append(f"  device inputs: {len(p.input_keys)} key(s), "
+                     f"~{p.wire_bytes_per_row:g} wire B/row")
+    if p.kind == "scan":
+        lines.append(f"  batches: {p.n_batches}"
+                     + (f", first-batch wire {_fmt_bytes(p.wire_bytes_per_batch)}"
+                        if p.wire_bytes_per_batch is not None else ""))
+        for g in p.family_groups:
+            tag = "batched" if g.batched else "solo"
+            lines.append(
+                f"  family group (where={g.where!r}, cap={g.cap}): "
+                f"{len(g.columns)} column(s) [{tag}]"
+                + (" +hll" if g.want_regs else "")
+            )
+    if p.estimated_groups is not None:
+        lines.append(f"  estimated groups: ~{p.estimated_groups}"
+                     + ("  !! spill" if p.spill_risk else ""))
+    for note in p.notes:
+        lines.append(f"  note: {note}")
+    return lines
+
+
+def render_explain(
+    cost: PlanCost, diagnostics: Sequence[Diagnostic] = ()
+) -> str:
+    """The EXPLAIN report: predicted execution shape, then diagnostics."""
+    head = [
+        "== Plan explain (static — no data scanned) ==",
+        f"analyzers: {len(cost.analyzers)}   placement: {cost.placement}   "
+        f"engine: {cost.engine}   compute dtype: {cost.compute_dtype}",
+        f"rows: {cost.num_rows if cost.num_rows is not None else '?'}   "
+        f"batch_size: {cost.batch_size if cost.batch_size is not None else 'default'}",
+    ]
+    if cost.num_hosts > 1:
+        head.append(
+            f"hosts: {cost.num_hosts}   allgather rounds: {cost.allgather_rounds}"
+        )
+    if cost.precondition_failures:
+        head.append(
+            f"precondition failures: {len(cost.precondition_failures)} "
+            "analyzer(s) will fail without scanning"
+        )
+        for rep, err in cost.precondition_failures:
+            head.append(f"  - {rep}: {err}")
+    body: List[str] = []
+    for i, p in enumerate(cost.passes, 1):
+        body.extend(_render_pass(p, i))
+    if not cost.passes:
+        body.append("(no passes: nothing to compute)")
+    sig = cost.dispatch_signature()
+    body.append(
+        "predicted counters: "
+        + ", ".join(f"{k}={v}" for k, v in sig["counters"].items())
+    )
+    spans = sig["spans"]
+    if spans:
+        body.append(
+            "predicted spans: "
+            + ", ".join(f"{k}×{v}" for k, v in spans.items())
+        )
+    tail: List[str] = []
+    if diagnostics:
+        tail.append(f"-- {len(diagnostics)} diagnostic(s) --")
+        tail.extend(d.render() for d in diagnostics)
+    else:
+        tail.append("-- no performance diagnostics --")
+    return "\n".join(head + body + tail)
+
+
+# -- entrypoint ---------------------------------------------------------------
+
+
+@dataclass
+class ExplainResult:
+    cost: PlanCost
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+
+    def render(self) -> str:
+        return render_explain(self.cost, self.diagnostics)
+
+    def __str__(self) -> str:
+        return self.render()
+
+
+def _plan_analyzers(analyzers: Sequence[Any], checks: Sequence[Any]) -> List[Any]:
+    from deequ_tpu.lint.planlint import _constraint_analyzers
+
+    occurrences: List[Any] = list(analyzers)
+    occurrences.extend(
+        inner.analyzer for _, inner in _constraint_analyzers(checks)
+    )
+    seen: set = set()
+    unique: List[Any] = []
+    for a in occurrences:
+        if a not in seen:
+            seen.add(a)
+            unique.append(a)
+    return unique
+
+
+def explain_plan(
+    data_or_schema: Any,
+    analyzers: Sequence[Any] = (),
+    checks: Sequence[Any] = (),
+    *,
+    num_rows: Optional[int] = None,
+    batch_size: Optional[int] = None,
+    placement: Optional[str] = None,
+    engine: str = "single",
+    num_hosts: int = 1,
+    num_devices: int = 1,
+) -> ExplainResult:
+    """EXPLAIN an analysis plan against a `Table` (schema and row count
+    are taken from it — still zero data scanned) or a `SchemaInfo`."""
+    if isinstance(data_or_schema, SchemaInfo):
+        schema = data_or_schema
+    else:
+        schema = SchemaInfo.from_table(data_or_schema)
+        if num_rows is None:
+            num_rows = int(data_or_schema.num_rows)
+    plan = _plan_analyzers(analyzers, checks)
+    cost = analyze_plan(
+        plan,
+        schema,
+        num_rows=num_rows,
+        batch_size=batch_size,
+        placement=placement,
+        engine=engine,
+        num_hosts=num_hosts,
+        num_devices=num_devices,
+    )
+    return ExplainResult(
+        cost=cost, diagnostics=cost_diagnostics(cost, plan, schema)
+    )
+
+
+def explain(
+    analyzers: Sequence[Any],
+    schema: SchemaInfo,
+    **kwargs: Any,
+) -> str:
+    """Render the EXPLAIN report for a plan as a string."""
+    return explain_plan(schema, analyzers=analyzers, **kwargs).render()
+
+
+__all__ = [
+    "DQ302_CAP_LIMIT",
+    "DQ303_TILE_BUDGET_BYTES",
+    "DQ303_TILE_ROWS",
+    "DQ304_MAX_BATCHES",
+    "DQ304_MIN_BATCH",
+    "ExplainResult",
+    "cost_diagnostics",
+    "explain",
+    "explain_plan",
+    "render_explain",
+]

@@ -382,15 +382,31 @@ def validate_plan(
     checks: Sequence = (),
     required_analyzers: Sequence = (),
     mode: str = "lenient",
+    num_rows: Optional[int] = None,
+    batch_size: Optional[int] = None,
 ) -> LintReport:
-    """Run the full static pass. mode: 'strict' raises one aggregated
-    PlanValidationError when any error-severity diagnostic exists;
+    """Run the full static pass: semantic lints (DQ1xx/DQ2xx) plus the
+    cost analyzer's performance lints (DQ3xx, lint/explain.py). The
+    computed `PlanCost` is attached as `report.plan_cost`. mode:
+    'strict' raises one aggregated PlanValidationError when any
+    error-severity diagnostic exists (warnings ride along in it);
     'lenient' returns the report for the caller to attach; 'off' skips."""
     from deequ_tpu.lint.diagnostics import PlanValidationError
 
     if mode == "off":
         return LintReport()
     report = lint_plan(schema, checks, required_analyzers)
+    try:
+        from deequ_tpu.lint.cost import analyze_plan
+        from deequ_tpu.lint.explain import _plan_analyzers, cost_diagnostics
+
+        plan = _plan_analyzers(required_analyzers, checks)
+        report.plan_cost = analyze_plan(
+            plan, schema, num_rows=num_rows, batch_size=batch_size
+        )
+        report.extend(cost_diagnostics(report.plan_cost, plan, schema))
+    except Exception:  # noqa: BLE001 — cost lint must never break a run
+        report.plan_cost = None
     if mode == "strict" and report.errors:
         raise PlanValidationError(report.diagnostics)
     return report

@@ -24,6 +24,10 @@ class FieldInfo:
     # True = the column MAY contain nulls. The analyzer is conservative:
     # over-reporting nullability is safe, under-reporting is not.
     nullable: bool = True
+    # Optional cardinality hint (e.g. from profiling) the cost analyzer
+    # uses to estimate grouping-pass group counts / spill risk (DQ302).
+    # None = unknown: no cardinality-based diagnostics fire.
+    approx_distinct: Optional[int] = None
 
 
 class SchemaInfo:

@@ -39,6 +39,11 @@ from deequ_tpu.observe.export import (
     merge_chrome_traces,
     write_chrome_trace,
 )
+from deequ_tpu.observe.compare import (
+    dispatch_signature,
+    observed_family_groups,
+    span_name_counts,
+)
 from deequ_tpu.observe.report import PHASES, phase_seconds, render_report
 from deequ_tpu.observe.runtrace import (
     ENV_KNOB,
@@ -70,6 +75,9 @@ __all__ = [
     "ENV_OUT",
     "RunTrace",
     "default_trace_path",
+    "dispatch_signature",
     "env_enabled",
+    "observed_family_groups",
+    "span_name_counts",
     "traced_run",
 ]

@@ -8,6 +8,7 @@ array — the analogue of the reference sharing `frequencies.agg(all fns)`
 
 from __future__ import annotations
 
+import threading
 from typing import TYPE_CHECKING, Any, Dict, List, Sequence
 
 import jax
@@ -25,6 +26,7 @@ if TYPE_CHECKING:
     )
 
 _FREQ_CACHE: Dict[Any, Any] = {}
+_FREQ_CACHE_LOCK = threading.Lock()
 
 # below this many groups the jit round-trip costs more than numpy
 _DEVICE_THRESHOLD = 1 << 16
@@ -32,14 +34,16 @@ _DEVICE_THRESHOLD = 1 << 16
 
 def _get_freq_fn(analyzers: Sequence["ScanShareableFrequencyBasedAnalyzer"]):
     key = (tuple(repr(a) for a in analyzers), bool(jax.config.jax_enable_x64))
-    fn = _FREQ_CACHE.get(key)
+    with _FREQ_CACHE_LOCK:
+        fn = _FREQ_CACHE.get(key)
     if fn is None:
 
         def fused(counts, num_rows):
             return tuple(a.freq_reduce(counts, num_rows, jnp) for a in analyzers)
 
         fn = jax.jit(fused)
-        _FREQ_CACHE[key] = fn
+        with _FREQ_CACHE_LOCK:
+            fn = _FREQ_CACHE.setdefault(key, fn)
     return fn
 
 

@@ -16,7 +16,9 @@ role the reference's `State.sum` plays after Catalyst partial aggregation.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, List, Optional, Sequence
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +36,7 @@ _FUSED_CACHE: Dict[Any, Any] = {}
 _FUSED_CACHE_MAX = 256  # insertion-order eviction; bounds memory on
 # long heterogeneous streams (layouts are sticky per pass, so steady
 # state is 1-2 entries per analyzer set)
+_FUSED_CACHE_LOCK = threading.Lock()
 
 
 def _pad_size(n: int, batch_size: int) -> int:
@@ -96,7 +99,8 @@ def get_fused_fn(
         layout,
         bool(jax.config.jax_enable_x64),
     )
-    cached = _FUSED_CACHE.get(key)
+    with _FUSED_CACHE_LOCK:
+        cached = _FUSED_CACHE.get(key)
     if cached is None:
         meta_box: Dict[str, Any] = {}
         if layout is None:
@@ -148,9 +152,12 @@ def get_fused_fn(
             return packed_out
 
         cached = (jax.jit(fused), meta_box)
-        _FUSED_CACHE[key] = cached
-        while len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
-            _FUSED_CACHE.pop(next(iter(_FUSED_CACHE)))
+        with _FUSED_CACHE_LOCK:
+            # two threads may have built concurrently: first insert wins
+            # so both use the same meta_box the traced program fills
+            cached = _FUSED_CACHE.setdefault(key, cached)
+            while len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
+                _FUSED_CACHE.pop(next(iter(_FUSED_CACHE)))
     return cached
 
 
@@ -269,6 +276,177 @@ def pack_batch_inputs(built_items, padded: int, dtype, sticky=None, num_rows=Non
         )
     layout = (tuple(groups), tuple(sorted(const_keys)), padded)
     return packed_inputs, layout
+
+
+# -- pure plan construction ---------------------------------------------------
+#
+# Everything the pass decides BEFORE it sees a row — member placement,
+# the deduplicated input-spec set, family-kernel job identity and
+# grouping — lives in the pure functions below. `FusedScanPass.run`,
+# `DistributedScanPass._run`, and `_precompute_family_kernels` consume
+# them at runtime; the static cost analyzer (deequ_tpu/lint/cost.py)
+# calls the SAME functions so its predictions cannot drift from the
+# planner (the trace-differential suite pins this).
+
+
+@dataclass
+class ScanMemberPlan:
+    """Data-free partition of one scan pass's members by placement.
+
+    Index lists refer to positions in the analyzer sequence handed to
+    `plan_scan_members`; an index appears in exactly one of the four
+    lists or in `spec_errors` (spec construction failed — that analyzer
+    fails alone, not the pass)."""
+
+    mode: str
+    merge_idx: List[int] = field(default_factory=list)
+    assisted_idx: List[int] = field(default_factory=list)
+    host_idx: List[int] = field(default_factory=list)
+    host_assisted_idx: List[int] = field(default_factory=list)
+    specs: Dict[str, Any] = field(default_factory=dict)
+    device_keys: set = field(default_factory=set)
+    host_keys: Dict[int, List[str]] = field(default_factory=dict)
+    spec_errors: Dict[int, BaseException] = field(default_factory=dict)
+
+    @property
+    def device_member_count(self) -> int:
+        return len(self.merge_idx) + len(self.assisted_idx)
+
+    @property
+    def host_member_count(self) -> int:
+        return len(self.host_idx) + len(self.host_assisted_idx)
+
+    @property
+    def any_members(self) -> bool:
+        return bool(
+            self.merge_idx
+            or self.assisted_idx
+            or self.host_idx
+            or self.host_assisted_idx
+        )
+
+
+def plan_scan_members(analyzers: Sequence[Any], mode: Optional[str] = None) -> ScanMemberPlan:
+    """Partition a scan's members by placement — pure and data-free.
+
+    Placement (runtime.placement_mode): on a slow device link, discrete
+    analyzers (mask/code-only inputs) — or, below the bandwidth floor,
+    EVERY analyzer — fold on the host inside the SAME logical scan
+    instead of shipping rows; `host_only` device-assisted members
+    (strings, dict codes) never ship regardless of placement."""
+    if mode is None:
+        mode = runtime.placement_mode()
+    plan = ScanMemberPlan(mode=mode)
+    host_all = mode == "host-all"
+    host_discrete = host_all or mode == "host-discrete"
+    for i, analyzer in enumerate(analyzers):
+        try:
+            analyzer_specs = analyzer.input_specs()
+        except Exception as e:  # noqa: BLE001
+            plan.spec_errors[i] = e
+            continue
+        if getattr(analyzer, "device_assisted", False):
+            if host_all or getattr(analyzer, "host_only", False):
+                plan.host_assisted_idx.append(i)
+                plan.host_keys[i] = [s.key for s in analyzer_specs]
+            else:
+                plan.assisted_idx.append(i)
+                plan.device_keys.update(s.key for s in analyzer_specs)
+        elif host_all or (
+            host_discrete and getattr(analyzer, "discrete_inputs", False)
+        ):
+            plan.host_idx.append(i)
+            plan.host_keys[i] = [s.key for s in analyzer_specs]
+        else:
+            plan.merge_idx.append(i)
+            plan.device_keys.update(s.key for s in analyzer_specs)
+        for spec in analyzer_specs:
+            plan.specs.setdefault(spec.key, spec)
+    return plan
+
+
+@dataclass(frozen=True)
+class FamilyJobPlan:
+    """One planned family-kernel job: the (column, where) family whose
+    fused moments + decimated quantile sample (+ HLL registers when an
+    ApproxCountDistinct on the same family consumes them) come out of a
+    single C traversal. Identity is the memo key `qkey`."""
+
+    column: str
+    where: Optional[str]
+    wkey: str
+    cap: int
+    want_regs: bool
+
+    @property
+    def qkey(self) -> str:
+        return f"__qsample:{self.column}:{self.wkey}:{self.cap}"
+
+    @property
+    def mkey(self) -> str:
+        return f"__moments:{self.column}:{self.wkey}"
+
+    @property
+    def rkey(self) -> str:
+        return f"__hllregs:{self.column}:{self.wkey}"
+
+
+def family_group_key(wkey: str, cap: int) -> Tuple[str, int]:
+    """Grouping key for batching family jobs into ONE multi-column
+    native traversal: same where mask, same sample cap. (All jobs of one
+    batch share the row count, so this is the full runtime key too.)"""
+    return (wkey, cap)
+
+
+def plan_family_jobs(
+    host_assisted_members: Sequence[Any],
+    host_members: Sequence[Any] = (),
+) -> List[FamilyJobPlan]:
+    """Plan the family-kernel jobs a host fold would run — pure and
+    data-free. One job per distinct (column, where, cap) family across
+    the host-assisted members (quantile sketches); `want_regs` marks
+    families whose HLL registers a host-folded ApproxCountDistinct on
+    the same (column, where) will consume."""
+    from deequ_tpu.analyzers.base import where_key
+
+    acd_families = {
+        (getattr(member, "column", None), where_key(getattr(member, "where", None)))
+        for member in host_members
+        if getattr(member, "name", "") == "ApproxCountDistinct"
+    }
+    jobs: List[FamilyJobPlan] = []
+    seen: set = set()
+    for member in host_assisted_members:
+        sample_size = getattr(member, "_sample_size", None)
+        column = getattr(member, "column", None)
+        if sample_size is None or column is None:
+            continue
+        where = getattr(member, "where", None)
+        wkey = where_key(where)
+        job = FamilyJobPlan(
+            column=column,
+            where=where,
+            wkey=wkey,
+            cap=int(sample_size()),
+            want_regs=(column, wkey) in acd_families,
+        )
+        if job.qkey in seen:
+            continue
+        seen.add(job.qkey)
+        jobs.append(job)
+    return jobs
+
+
+def group_family_jobs(
+    jobs: Sequence[FamilyJobPlan],
+) -> List[Tuple[Tuple[str, int], List[FamilyJobPlan]]]:
+    """Group planned family jobs by `family_group_key` — each group is
+    one (possibly multi-column batched) native kernel dispatch per
+    batch. Order: first appearance, matching the runtime dispatch."""
+    groups: Dict[Tuple[str, int], List[FamilyJobPlan]] = {}
+    for job in jobs:
+        groups.setdefault(family_group_key(job.wkey, job.cap), []).append(job)
+    return list(groups.items())
 
 
 class AnalyzerRunResult:
@@ -592,36 +770,31 @@ def _precompute_family_kernels(
     traversal (masked_moments_select_multi) — the across-column leg of
     scan sharing. `DEEQU_TPU_NO_MULTI_FAMILY=1` forces the per-column
     kernel (the batched path is bit-identical; the toggle exists for
-    parity testing and triage)."""
-    from deequ_tpu.analyzers.base import where_key
+    parity testing and triage).
+
+    Job identity and grouping come from the PURE planner
+    (`plan_family_jobs`/`group_family_jobs`) — the static cost analyzer
+    calls the same functions; this body only adds the data-dependent
+    parts (counts shortcut, array builds, kernel dispatch)."""
     from deequ_tpu.ops import counts_family, native
 
-    # HLL piggybacking is only worth the per-row hash when a host-folded
+    # dead members don't pay their family kernel; HLL piggybacking is
+    # only worth the per-row hash when a live host-folded
     # ApproxCountDistinct on the same (column, where) will consume it
-    acd_families = {
-        (member.column, where_key(getattr(member, "where", None)))
-        for i, member in host_members
-        if getattr(member, "name", "") == "ApproxCountDistinct"
-        and i not in host_errors
-    }
+    planned = plan_family_jobs(
+        [member for i, member in host_assisted if i not in host_errors],
+        host_members=[
+            member for i, member in host_members if i not in host_errors
+        ],
+    )
     counts_ok = counts_family.enabled()
     jobs = []
-    for i, member in host_assisted:
-        if i in host_errors:
-            continue  # dead member: don't pay its family kernel
-        sample_size = getattr(member, "_sample_size", None)
-        column = getattr(member, "column", None)
-        if sample_size is None or column is None:
+    for pj in planned:
+        column, where, wkey = pj.column, pj.where, pj.wkey
+        cap, want_regs = pj.cap, pj.want_regs
+        qkey, mkey, rkey = pj.qkey, pj.mkey, pj.rkey
+        if qkey in built:
             continue
-        where = getattr(member, "where", None)
-        wkey = where_key(where)
-        cap = int(sample_size())
-        qkey = f"__qsample:{column}:{wkey}:{cap}"
-        mkey = f"__moments:{column}:{wkey}"
-        if qkey in built or any(j[0] == qkey for j in jobs):
-            continue
-        rkey = f"__hllregs:{column}:{wkey}"
-        want_regs = (column, wkey) in acd_families
         miss_key = ("counts_miss", column, wkey)
         if family_memo is not None and miss_key in family_memo:
             shortcut = False  # known miss: same column, same stream
@@ -665,14 +838,14 @@ def _precompute_family_kernels(
         else:
             hll_mode, hashvals = 0, None
         jobs.append(
-            (qkey, mkey, rkey, x, valid, warr, cap, hll_mode, hashvals, wkey)
+            (qkey, mkey, rkey, x, valid, warr, cap, hll_mode, hashvals, wkey, column)
         )
 
     if not jobs:
         return
 
     def run_one(job):
-        qkey, mkey, rkey, x, valid, warr, cap, hll_mode, hashvals, _w = job
+        qkey, mkey, rkey, x, valid, warr, cap, hll_mode, hashvals, _w, _col = job
         try:
             return (
                 native.masked_moments_select(
@@ -683,13 +856,14 @@ def _precompute_family_kernels(
         except Exception:  # noqa: BLE001
             return None, len(x)
 
-    # batch same-(where, cap) same-length families into one traversal;
-    # singleton groups keep the solo kernel (same machinery, no batching
-    # overhead to amortize)
+    # batch same-(where, cap) families into one traversal (all jobs of
+    # one batch share the row count — `family_group_key` is the full
+    # grouping decision); singleton groups keep the solo kernel (same
+    # machinery, no batching overhead to amortize)
     no_multi = os.environ.get("DEEQU_TPU_NO_MULTI_FAMILY", "") not in ("", "0")
     group_map: Dict[Any, list] = {}
     for idx, job in enumerate(jobs):
-        group_map.setdefault((job[9], job[6], len(job[3])), []).append(idx)
+        group_map.setdefault(family_group_key(job[9], job[6]), []).append(idx)
     groups = list(group_map.values())
 
     # worker-pool threads adopt the dispatching thread's trace context so
@@ -707,6 +881,7 @@ def _precompute_family_kernels(
             rows=len(job0[3]),
             dtype=str(job0[3].dtype),
             columns=len(idxs),
+            cols=",".join(jobs[i][10] for i in idxs),
             batched=len(idxs) > 1 and not no_multi,
         ):
             if len(idxs) > 1 and not no_multi:
@@ -895,59 +1070,31 @@ class FusedScanPass:
         )
 
     def run(self, table: Table) -> List[AnalyzerRunResult]:
-        # 1. collect input specs; an analyzer whose spec construction fails
-        #    (e.g. unparseable predicate) fails alone, not the pass.
-        #    Placement (runtime.placement_mode): on a slow device link,
-        #    discrete analyzers (mask/code-only inputs) — or, below the
-        #    bandwidth floor, EVERY analyzer — fold on the host inside
-        #    the SAME logical scan instead of shipping rows.
-        merge_idx: List[int] = []
-        assisted_idx: List[int] = []
-        host_idx: List[int] = []
-        host_assisted_idx: List[int] = []
+        # 1. plan: member placement + deduplicated input specs via the
+        #    pure planner (an analyzer whose spec construction fails —
+        #    e.g. unparseable predicate — fails alone, not the pass)
         results: Dict[int, AnalyzerRunResult] = {}
-        specs: Dict[str, Any] = {}
-        device_keys: set = set()
-        host_keys: Dict[int, List[str]] = {}
         with observe.span(
             "plan_fuse", cat="plan", analyzers=len(self.analyzers)
         ) as plan_sp:
-            mode = runtime.placement_mode()
-            host_all = mode == "host-all"
-            host_discrete = host_all or mode == "host-discrete"
-            for i, analyzer in enumerate(self.analyzers):
-                try:
-                    analyzer_specs = analyzer.input_specs()
-                except Exception as e:  # noqa: BLE001
-                    results[i] = AnalyzerRunResult(analyzer, error=e)
-                    continue
-                if getattr(analyzer, "device_assisted", False):
-                    if host_all or getattr(analyzer, "host_only", False):
-                        # host_only: inputs (strings, dict codes) never ship
-                        # to the device regardless of placement
-                        host_assisted_idx.append(i)
-                        host_keys[i] = [s.key for s in analyzer_specs]
-                    else:
-                        assisted_idx.append(i)
-                        device_keys.update(s.key for s in analyzer_specs)
-                elif host_all or (
-                    host_discrete and getattr(analyzer, "discrete_inputs", False)
-                ):
-                    host_idx.append(i)
-                    host_keys[i] = [s.key for s in analyzer_specs]
-                else:
-                    merge_idx.append(i)
-                    device_keys.update(s.key for s in analyzer_specs)
-                for spec in analyzer_specs:
-                    specs.setdefault(spec.key, spec)
+            plan = plan_scan_members(self.analyzers)
+            for i, err in plan.spec_errors.items():
+                results[i] = AnalyzerRunResult(self.analyzers[i], error=err)
             plan_sp.set(
-                placement=mode,
-                input_keys=len(specs),
-                device_members=len(merge_idx) + len(assisted_idx),
-                host_members=len(host_idx) + len(host_assisted_idx),
+                placement=plan.mode,
+                input_keys=len(plan.specs),
+                device_members=plan.device_member_count,
+                host_members=plan.host_member_count,
             )
+        merge_idx = plan.merge_idx
+        assisted_idx = plan.assisted_idx
+        host_idx = plan.host_idx
+        host_assisted_idx = plan.host_assisted_idx
+        specs = plan.specs
+        device_keys = plan.device_keys
+        host_keys = plan.host_keys
 
-        if merge_idx or assisted_idx or host_idx or host_assisted_idx:
+        if plan.any_members:
             table = prune_table_columns(table, specs)
             merge_analyzers = [self.analyzers[i] for i in merge_idx]
             assisted = [self.analyzers[i] for i in assisted_idx]

@@ -87,7 +87,12 @@ def _sanitize_flags() -> list:
     """DEEQU_TPU_SANITIZE=address,undefined adds -fsanitize instrumentation
     to the native build (a debugging mode, not a production path: the
     resulting .so usually needs the sanitizer runtime LD_PRELOADed into
-    the host python). Empty list when unset."""
+    the host python). DEEQU_TPU_SANITIZE=thread builds with ThreadSanitizer
+    instead — the kernels release the GIL and run concurrently (the
+    family worker pool, independent scan threads), so TSan is the mode
+    that checks the C side's data-race freedom; it cannot be combined
+    with address/leak sanitizers (a toolchain rule — the build would
+    fail). Empty list when unset."""
     spec = os.environ.get("DEEQU_TPU_SANITIZE", "").strip()
     if not spec:
         return []

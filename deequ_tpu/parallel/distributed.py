@@ -17,6 +17,7 @@ only state pytrees (bytes to KB) ever cross host boundaries, never rows.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -35,12 +36,14 @@ from deequ_tpu.ops.fused import (
     _pad_size,
     fold_host_batch,
     materialize_host_results,
+    plan_scan_members,
     prune_table_columns,
 )
 
 DATA_AXIS = "data"
 
 _DIST_CACHE: Dict[Any, Any] = {}
+_DIST_CACHE_LOCK = threading.Lock()
 
 
 def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
@@ -83,7 +86,8 @@ def _get_distributed_fn(analyzers, mesh: Mesh, axis_name: str, assisted=()):
         axis_name,
         bool(jax.config.jax_enable_x64),
     )
-    fn = _DIST_CACHE.get(key)
+    with _DIST_CACHE_LOCK:
+        fn = _DIST_CACHE.get(key)
     if fn is not None:
         return fn
 
@@ -132,7 +136,8 @@ def _get_distributed_fn(analyzers, mesh: Mesh, axis_name: str, assisted=()):
         check_vma=False,
     )
     fn = jax.jit(sharded)
-    _DIST_CACHE[key] = fn
+    with _DIST_CACHE_LOCK:
+        fn = _DIST_CACHE.setdefault(key, fn)
     return fn
 
 
@@ -164,52 +169,23 @@ class DistributedScanPass:
             return self._run(table)
 
     def _run(self, table: Table) -> List[AnalyzerRunResult]:
-        # same placement policy as FusedScanPass: on a slow device link,
-        # discrete (mask/code-only) analyzers — or under 'host-all',
-        # every analyzer — fold on the host while the mesh reduces the rest
-        mode = runtime.placement_mode()
-        host_all = mode == "host-all"
-        host_discrete = host_all or mode == "host-discrete"
-        merge_analyzers: List[ScanShareableAnalyzer] = []
-        merge_idx: List[int] = []
-        assisted: List[ScanShareableAnalyzer] = []
-        assisted_idx: List[int] = []
-        host_members: List[tuple] = []
-        host_assisted: List[tuple] = []
-        host_member_keys: Dict[int, List[str]] = {}
+        # same placement policy as FusedScanPass — the shared pure
+        # planner partitions members: on a slow device link, discrete
+        # (mask/code-only) analyzers — or under 'host-all', every
+        # analyzer — fold on the host while the mesh reduces the rest
+        plan = plan_scan_members(self.analyzers)
         results: Dict[int, AnalyzerRunResult] = {}
-        specs: Dict[str, Any] = {}
-        device_keys: set = set()
-
-        for i, analyzer in enumerate(self.analyzers):
-            try:
-                analyzer_specs = analyzer.input_specs()
-            except Exception as e:  # noqa: BLE001
-                results[i] = AnalyzerRunResult(analyzer, error=e)
-                continue
-            for spec in analyzer_specs:
-                specs.setdefault(spec.key, spec)
-            host_only = getattr(analyzer, "host_only", False)
-            if (
-                getattr(analyzer, "device_assisted", False)
-                and not host_all
-                and not host_only
-            ):
-                assisted.append(analyzer)
-                assisted_idx.append(i)
-                device_keys.update(s.key for s in analyzer_specs)
-            elif getattr(analyzer, "device_assisted", False):
-                host_assisted.append((i, analyzer))
-                host_member_keys[i] = [s.key for s in analyzer_specs]
-            elif host_all or (
-                host_discrete and getattr(analyzer, "discrete_inputs", False)
-            ):
-                host_members.append((i, analyzer))
-                host_member_keys[i] = [s.key for s in analyzer_specs]
-            else:
-                merge_analyzers.append(analyzer)
-                merge_idx.append(i)
-                device_keys.update(s.key for s in analyzer_specs)
+        for i, err in plan.spec_errors.items():
+            results[i] = AnalyzerRunResult(self.analyzers[i], error=err)
+        merge_idx = plan.merge_idx
+        assisted_idx = plan.assisted_idx
+        merge_analyzers = [self.analyzers[i] for i in merge_idx]
+        assisted = [self.analyzers[i] for i in assisted_idx]
+        host_members = [(i, self.analyzers[i]) for i in plan.host_idx]
+        host_assisted = [(i, self.analyzers[i]) for i in plan.host_assisted_idx]
+        host_member_keys = plan.host_keys
+        specs = plan.specs
+        device_keys = plan.device_keys
 
         table = prune_table_columns(table, specs)
         n_devices = self.mesh.shape[self.axis_name]
@@ -370,6 +346,7 @@ class DistributedScanPass:
 
 
 _BINCOUNT_CACHE: Dict[Any, Any] = {}
+_BINCOUNT_CACHE_LOCK = threading.Lock()
 
 
 def sharded_bincount(
@@ -392,7 +369,8 @@ def sharded_bincount(
     np.copyto(full[: len(codes)], np.where(codes >= 0, codes, nbins))
 
     key = (padded_rows, nbins_p, mesh, axis_name)
-    fn = _BINCOUNT_CACHE.get(key)
+    with _BINCOUNT_CACHE_LOCK:
+        fn = _BINCOUNT_CACHE.get(key)
     if fn is None:
 
         def per_device(c):
@@ -408,7 +386,8 @@ def sharded_bincount(
                 check_vma=False,
             )
         )
-        _BINCOUNT_CACHE[key] = fn
+        with _BINCOUNT_CACHE_LOCK:
+            fn = _BINCOUNT_CACHE.setdefault(key, fn)
     with observe.span(
         "group_bincount",
         cat="dispatch",

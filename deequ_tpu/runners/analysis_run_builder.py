@@ -56,6 +56,15 @@ class AnalysisRunBuilder:
         self._mesh = mesh
         return self
 
+    def explain(self, **kwargs):
+        """EXPLAIN the planned run without scanning a row: the static
+        cost/effect prediction (passes, batches, wire bytes, family
+        groups) plus DQ3xx performance diagnostics, as an
+        `ExplainResult` (render with `str(...)`)."""
+        from deequ_tpu.lint.explain import explain_plan
+
+        return explain_plan(self._data, analyzers=self._analyzers, **kwargs)
+
     def add_analyzer(self, analyzer: Analyzer) -> "AnalysisRunBuilder":
         self._analyzers.append(analyzer)
         return self

@@ -96,7 +96,7 @@ class AnalysisRunner:
         # before any kernel dispatch, lenient attaches diagnostics to the
         # returned context as `validation_warnings`
         with observe.span("plan_validate", cat="plan"):
-            validation_diagnostics = AnalysisRunner._validate_plan(
+            validation_diagnostics, plan_cost = AnalysisRunner._validate_plan(
                 data, analyzers, validation
             )
 
@@ -178,6 +178,7 @@ class AnalysisRunner:
             reused + precondition_failures + scanning_results + grouping_results
         )
         context.validation_warnings = validation_diagnostics
+        context.plan_cost = plan_cost
 
         # 6. save (reference: AnalysisRunner.scala:182-230)
         if metrics_repository is not None and save_or_append_results_with_key is not None:
@@ -188,23 +189,29 @@ class AnalysisRunner:
 
     # ------------------------------------------------------------------
     @staticmethod
-    def _validate_plan(data, analyzers, validation) -> List:
+    def _validate_plan(data, analyzers, validation):
+        """-> (diagnostics, PlanCost | None). The cost prediction rides
+        the same static pass and lands on the context as `plan_cost`."""
         from deequ_tpu.lint import PlanValidationError, SchemaInfo, validate_plan
         from deequ_tpu.lint.planlint import resolve_validation_mode
 
         mode = resolve_validation_mode(validation)
         if mode == "off":
-            return []
+            return [], None
         try:
             schema = SchemaInfo.from_table(data)
             report = validate_plan(
-                schema, checks=(), required_analyzers=analyzers, mode=mode
+                schema,
+                checks=(),
+                required_analyzers=analyzers,
+                mode=mode,
+                num_rows=int(data.num_rows),
             )
-            return list(report.diagnostics)
+            return list(report.diagnostics), report.plan_cost
         except PlanValidationError:
             raise
         except Exception:  # noqa: BLE001 — lint must never break a run
-            return []
+            return [], None
 
     # ------------------------------------------------------------------
     @staticmethod

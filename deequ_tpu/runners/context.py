@@ -38,6 +38,10 @@ class AnalyzerContext:
         # observability: the run's RunTrace (deequ_tpu.observe) when
         # tracing was enabled, else None; also excluded from equality
         self.run_trace = None
+        # static cost prediction (lint/cost.PlanCost) from the same
+        # validation pass; None when validation is off. Excluded from
+        # equality like the other side-channel attachments.
+        self.plan_cost = None
 
     @staticmethod
     def empty() -> "AnalyzerContext":

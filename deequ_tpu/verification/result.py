@@ -30,6 +30,9 @@ class VerificationResult:
     # observability: the run's RunTrace (deequ_tpu.observe) when tracing
     # was enabled via with_tracing(...) or DEEQU_TPU_TRACE, else None
     run_trace: object = None
+    # static cost prediction (lint/cost.PlanCost) from the validation
+    # pass; None when validation is off
+    plan_cost: object = None
 
     # -- metric exporters (reference: VerificationResult.scala:40-72) --------
 

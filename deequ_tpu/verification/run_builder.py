@@ -56,6 +56,19 @@ class VerificationRunBuilder:
         self._mesh = mesh
         return self
 
+    def explain(self, **kwargs):
+        """EXPLAIN the planned verification without scanning a row: the
+        static cost/effect prediction plus DQ3xx performance
+        diagnostics, as an `ExplainResult` (render with `str(...)`)."""
+        from deequ_tpu.lint.explain import explain_plan
+
+        return explain_plan(
+            self._data,
+            analyzers=self._required_analyzers,
+            checks=self._checks,
+            **kwargs,
+        )
+
     def with_plan_validation(self, mode: str) -> "VerificationRunBuilder":
         """Plan-time static analysis mode: "strict" raises one aggregated
         PlanValidationError before any scan, "lenient" (default) attaches

@@ -73,8 +73,10 @@ class VerificationSuite:
                 analyzers.extend(check.required_analyzers())
 
             with observe.span("plan_validate", cat="plan"):
-                validation_diagnostics = VerificationSuite._validate_plan(
-                    data, checks, required_analyzers, validation
+                validation_diagnostics, plan_cost = (
+                    VerificationSuite._validate_plan(
+                        data, checks, required_analyzers, validation
+                    )
                 )
 
             analysis_results = AnalysisRunner.do_analysis_run(
@@ -103,6 +105,7 @@ class VerificationSuite:
                 checks, analysis_results
             )
             verification_result.validation_warnings = validation_diagnostics
+            verification_result.plan_cost = plan_cost
 
             if (
                 metrics_repository is not None
@@ -119,24 +122,31 @@ class VerificationSuite:
         return verification_result
 
     @staticmethod
-    def _validate_plan(data, checks, required_analyzers, validation) -> List:
-        """Static plan analysis before any scan. Strict mode propagates
-        the aggregated PlanValidationError; otherwise the linter must
-        never break a run — any internal failure is swallowed."""
+    def _validate_plan(data, checks, required_analyzers, validation):
+        """Static plan analysis before any scan -> (diagnostics,
+        PlanCost | None). Strict mode propagates the aggregated
+        PlanValidationError; otherwise the linter must never break a
+        run — any internal failure is swallowed."""
         from deequ_tpu.lint import PlanValidationError, SchemaInfo, validate_plan
         from deequ_tpu.lint.planlint import resolve_validation_mode
 
         mode = resolve_validation_mode(validation)
         if mode == "off":
-            return []
+            return [], None
         try:
             schema = SchemaInfo.from_table(data)
-            report = validate_plan(schema, checks, required_analyzers, mode=mode)
-            return list(report.diagnostics)
+            report = validate_plan(
+                schema,
+                checks,
+                required_analyzers,
+                mode=mode,
+                num_rows=int(data.num_rows),
+            )
+            return list(report.diagnostics), report.plan_cost
         except PlanValidationError:
             raise
         except Exception:  # noqa: BLE001
-            return []
+            return [], None
 
     @staticmethod
     def run_on_aggregated_states(

@@ -1,11 +1,15 @@
-"""ASan/UBSan smoke test for the native kernels (ISSUE 2, satellite).
+"""ASan/UBSan/TSan smoke tests for the native kernels.
 
-Builds the C kernel with DEEQU_TPU_SANITIZE=address,undefined in a
+Builds the C kernel with DEEQU_TPU_SANITIZE=address,undefined (ISSUE 2
+satellite) or DEEQU_TPU_SANITIZE=thread (ISSUE 4 satellite) in a
 subprocess (the sanitizer runtime must be LD_PRELOADed before python
 starts, so an in-process test cannot work) and drives the batched
-multi-family kernel through it. Any heap overflow / UB the instrumented
-build detects aborts the subprocess, failing the test; environments
-without a sanitizer-capable toolchain skip.
+multi-family kernel through it. The TSan variant hammers the kernels
+from concurrent threads — the exact shape the family worker pool and
+parallel scan threads produce, since the kernels release the GIL. Any
+heap overflow / UB / data race the instrumented build detects aborts
+the subprocess, failing the test; environments without a
+sanitizer-capable toolchain skip.
 """
 
 from __future__ import annotations
@@ -18,12 +22,12 @@ import tempfile
 import pytest
 
 
-def _sanitizer_runtime():
-    """Path to libasan.so via the toolchain, or None when unavailable."""
+def _sanitizer_runtime(library: str = "libasan.so"):
+    """Path to a sanitizer runtime via the toolchain, or None."""
     for compiler in ("cc", "gcc"):
         try:
             out = subprocess.run(
-                [compiler, "-print-file-name=libasan.so"],
+                [compiler, f"-print-file-name={library}"],
                 capture_output=True,
                 text=True,
                 timeout=30,
@@ -137,3 +141,108 @@ def test_sanitize_flags_parse():
             os.environ["DEEQU_TPU_SANITIZE"] = old
         else:
             os.environ.pop("DEEQU_TPU_SANITIZE", None)
+
+
+_TSAN_DRIVER = r"""
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+import deequ_tpu.ops.native as native
+
+path = native._build_library()
+if path is None:
+    print("BUILD_UNAVAILABLE")
+    sys.exit(0)
+lib = native._load()
+if lib is None:
+    print("LOAD_UNAVAILABLE")
+    sys.exit(0)
+assert native.available()
+
+rng = np.random.default_rng(11)
+n = 8192
+N_THREADS = 4
+ROUNDS = 8
+
+# per-thread inputs: the kernels must be race-free even when every
+# thread traverses its OWN arrays concurrently (thread-local arenas),
+# and when two threads share the SAME read-only input (the family pool
+# dispatches same-batch groups concurrently)
+shared_x = rng.random(n)
+shared_valid = rng.random(n) > 0.05
+shared_where = rng.random(n) > 0.3
+
+def work(seed):
+    r = np.random.default_rng(seed)
+    x = r.random(n)
+    valid = r.random(n) > 0.05
+    where = r.random(n) > 0.3
+    for _ in range(ROUNDS):
+        own = native.masked_moments_select(x, valid, where, cap=256, hll_mode=1)
+        assert own is not None
+        cols = [(x, valid, 1, None), (shared_x, shared_valid, 1, None)]
+        multi = native.masked_moments_select_multi(cols, where, cap=256)
+        assert multi is None or len(multi) == 2
+        sh = native.masked_moments_select(
+            shared_x, shared_valid, shared_where, cap=128
+        )
+        assert sh is not None
+        vals = r.integers(0, 500, n)
+        packed = native.xxhash64_pack(vals, np.ones(n, dtype=bool))
+        assert packed is not None
+        counts = native.bincount(vals.astype(np.int64), 500)
+        assert counts is not None and counts.sum() == n
+    # deterministic reference: same shared inputs -> same moments
+    mom = native.masked_moments_select(
+        shared_x, shared_valid, shared_where, cap=128
+    )[0]
+    return tuple(mom[:4])
+
+with ThreadPoolExecutor(max_workers=N_THREADS) as pool:
+    results = list(pool.map(work, range(N_THREADS)))
+assert len(set(results)) == 1, "concurrent runs diverged: " + repr(results)
+print("TSAN_OK")
+"""
+
+
+def test_tsan_build_runs_clean_multithreaded():
+    """DEEQU_TPU_SANITIZE=thread: the kernels driven concurrently from
+    multiple threads under ThreadSanitizer — the native layer's
+    concurrency contract (GIL-released kernels, thread-local arenas,
+    read-only shared inputs) checked by the instrument, not by luck."""
+    runtime = _sanitizer_runtime("libtsan.so")
+    if runtime is None:
+        pytest.skip("no TSan-capable toolchain")
+
+    with tempfile.TemporaryDirectory() as cache:
+        env = dict(os.environ)
+        env.update(
+            {
+                "DEEQU_TPU_SANITIZE": "thread",
+                "DEEQU_TPU_CACHE_DIR": cache,
+                "LD_PRELOAD": runtime,
+                # only the kernel's races matter; halt hard when one is
+                # found so the assertion below cannot miss it
+                "TSAN_OPTIONS": "halt_on_error=1,exitcode=66",
+                "JAX_PLATFORMS": "cpu",
+            }
+        )
+        env.pop("DEEQU_TPU_NO_NATIVE", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", _TSAN_DRIVER],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        if "BUILD_UNAVAILABLE" in proc.stdout or "LOAD_UNAVAILABLE" in proc.stdout:
+            pytest.skip("TSan native build unavailable in this environment")
+        assert proc.returncode == 0, (
+            f"TSan run failed (rc={proc.returncode})\n"
+            f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+        )
+        assert "TSAN_OK" in proc.stdout
+        assert "WARNING: ThreadSanitizer" not in proc.stderr

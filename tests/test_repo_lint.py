@@ -177,3 +177,107 @@ def test_lint_main_is_invocable_as_script():
         cwd=REPO,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- GLOBALMUT: unguarded module-global mutation (ISSUE 4 satellite) ---------
+
+
+def test_globalmut_flags_unguarded_cache_write():
+    lint = _lint_module()
+    path = _tmp_source(
+        "_CACHE = {}\n"
+        "def get(key):\n"
+        "    if key not in _CACHE:\n"
+        "        _CACHE[key] = object()\n"
+        "    return _CACHE[key]\n"
+    )
+    try:
+        findings = lint.check_global_mutation(path)
+    finally:
+        os.unlink(path)
+    assert any("GLOBALMUT" in f and "_CACHE" in f for f in findings)
+
+
+def test_globalmut_flags_mutator_method_calls():
+    lint = _lint_module()
+    path = _tmp_source(
+        "_SEEN = []\n"
+        "_IDX = {}\n"
+        "def add(x):\n"
+        "    _SEEN.append(x)\n"
+        "def index(k, v):\n"
+        "    _IDX.setdefault(k, v)\n"
+    )
+    try:
+        findings = lint.check_global_mutation(path)
+    finally:
+        os.unlink(path)
+    assert sum("GLOBALMUT" in f for f in findings) == 2
+
+
+def test_globalmut_allows_lock_guarded_mutation():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import threading\n"
+        "_CACHE = {}\n"
+        "_CACHE_LOCK = threading.Lock()\n"
+        "def get(key, value):\n"
+        "    with _CACHE_LOCK:\n"
+        "        _CACHE[key] = value\n"
+        "    return _CACHE[key]\n"
+    )
+    try:
+        findings = lint.check_global_mutation(path)
+    finally:
+        os.unlink(path)
+    assert findings == []
+
+
+def test_globalmut_allows_allowlisted_assignment():
+    lint = _lint_module()
+    path = _tmp_source(
+        "_REGISTRY = {}  # global-ok: populated once at import time\n"
+        "def register(name, fn):\n"
+        "    _REGISTRY[name] = fn\n"
+    )
+    try:
+        findings = lint.check_global_mutation(path)
+    finally:
+        os.unlink(path)
+    assert findings == []
+
+
+def test_globalmut_respects_local_shadowing_and_global_decl():
+    lint = _lint_module()
+    path = _tmp_source(
+        "_STATE = {}\n"
+        "def shadowed():\n"
+        "    _STATE = {}\n"
+        "    _STATE['k'] = 1\n"  # local: fine
+        "    return _STATE\n"
+        "def declared():\n"
+        "    global _STATE\n"
+        "    _STATE = {}\n"  # rebind only, not a mutation finding
+        "    _STATE['k'] = 1\n"  # mutation of the module global
+        "    return _STATE\n"
+    )
+    try:
+        findings = lint.check_global_mutation(path)
+    finally:
+        os.unlink(path)
+    assert sum("GLOBALMUT" in f for f in findings) == 1
+    assert all("declared" not in f or "'k'" not in f for f in findings)
+
+
+def test_globalmut_reads_are_not_findings():
+    lint = _lint_module()
+    path = _tmp_source(
+        "_TABLE = {'a': 1}\n"
+        "def read(k):\n"
+        "    return _TABLE.get(k, 0) + len(_TABLE)\n"
+    )
+    try:
+        findings = lint.check_global_mutation(path)
+    finally:
+        os.unlink(path)
+    assert findings == []

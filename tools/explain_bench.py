@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Smoke EXPLAIN over the benchmark plans — the `make analyze` leg that
+proves the static cost analyzer runs end-to-end.
+
+Builds the bench schema WITHOUT building bench data (a zero-row slice of
+the same column layout), EXPLAINs the scan-bench analyzer plan at the
+bench's default row count, and exits non-zero if the analyzer fails or
+predicts an empty plan. Runs in a couple of seconds; scans nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    import bench
+    from deequ_tpu.lint import SchemaInfo, explain_plan
+
+    # zero rows: same dtype/nullability layout the bench scans, no data
+    table = bench.build_table(0)
+    schema = SchemaInfo.from_table(table)
+    analyzers = bench.scan_analyzers()
+
+    result = explain_plan(
+        schema, analyzers=analyzers, num_rows=10_000_000, placement="device"
+    )
+    print(result.render())
+
+    cost = result.cost
+    scan = cost.scan_pass
+    if scan is None or not cost.analyzers:
+        print("explain_bench: FAILED — no scan pass predicted", file=sys.stderr)
+        return 1
+    if cost.precondition_failures:
+        print(
+            "explain_bench: FAILED — bench plan has precondition failures",
+            file=sys.stderr,
+        )
+        return 1
+    errors = [d for d in result.diagnostics if d.severity.value == "error"]
+    if errors:
+        print(
+            f"explain_bench: FAILED — {len(errors)} error diagnostic(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"explain_bench: OK — {len(cost.analyzers)} analyzers, "
+        f"{len(cost.passes)} pass(es), {scan.n_batches} batch(es), "
+        f"{len(result.diagnostics)} diagnostic(s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

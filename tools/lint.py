@@ -13,6 +13,13 @@ Checks:
              deequ_tpu.observe (span()/timed_call()) so traces stay the
              single source of runtime truth and the disabled path keeps
              its measured near-zero overhead.
+  GLOBALMUT — module-global dicts/lists in deequ_tpu/ops/, runners/,
+             and parallel/ must not be mutated inside functions without
+             a lock: engine code runs on worker threads (the family
+             pool, user threads) and an unguarded shared cache is the
+             exact bug class the ExecutionStats fix in PR 3 removed.
+             Guard the mutation with `with <...lock...>:` or allowlist
+             the ASSIGNMENT line with a `# global-ok: <reason>` comment.
   F401*    — unused imports (fallback when ruff is unavailable).
   E722*    — bare `except:` (fallback when ruff is unavailable).
 
@@ -42,6 +49,26 @@ TIMING_FORBIDDEN = {
     "perf_counter_ns",
     "monotonic",
     "monotonic_ns",
+}
+# Dirs where module-global mutable state must be lock-guarded (engine
+# code here runs on worker threads: family pool, user threads, mesh).
+GLOBALMUT_DIRS = (
+    os.path.join("deequ_tpu", "ops"),
+    os.path.join("deequ_tpu", "runners"),
+    os.path.join("deequ_tpu", "parallel"),
+)
+GLOBALMUT_MUTATORS = {
+    "append",
+    "extend",
+    "insert",
+    "add",
+    "update",
+    "setdefault",
+    "pop",
+    "popitem",
+    "clear",
+    "remove",
+    "discard",
 }
 
 
@@ -130,6 +157,182 @@ def check_timing_calls(path: str) -> List[str]:
                 f"code — use deequ_tpu.observe (span()/timed_call()) so "
                 f"the measurement lands in the trace"
             )
+    return findings
+
+
+# -- GLOBALMUT: unguarded module-global mutable state ------------------------
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.DictComp, ast.ListComp)):
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in ("dict", "list")
+        and not node.args
+        and not node.keywords
+    )
+
+
+def _lockish(expr: ast.AST) -> bool:
+    """Does a `with` context expression look like a lock acquisition?
+    Heuristic: any name/attribute in it contains 'lock' (e.g.
+    `_FUSED_CACHE_LOCK`, `self._lock`, `lock.acquire_timeout(...)`)."""
+    for node in ast.walk(expr):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name is not None and "lock" in name.lower():
+            return True
+    return False
+
+
+def _bound_names(fn: ast.AST) -> set:
+    """Names bound in this function's own scope (params + assignment/
+    loop/with/except targets), nested scopes excluded."""
+    bound = set()
+    args = fn.args
+    for a in (
+        list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+    ):
+        bound.add(a.arg)
+    if args.vararg:
+        bound.add(args.vararg.arg)
+    if args.kwarg:
+        bound.add(args.kwarg.arg)
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                bound.add(child.name)  # a nested def/class binds its name
+                continue
+            if isinstance(child, ast.Lambda):
+                continue
+            if isinstance(child, ast.Name) and isinstance(
+                child.ctx, (ast.Store, ast.Del)
+            ):
+                bound.add(child.id)
+            visit(child)
+
+    visit(fn)
+    return bound
+
+
+def check_global_mutation(path: str) -> List[str]:
+    """Flag mutations of module-level dicts/lists inside functions that
+    are neither under a lock `with` nor allowlisted (`# global-ok:` on
+    the module-level assignment line)."""
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    tree = ast.parse(source, filename=path)
+    lines = source.splitlines()
+
+    mutable_globals: set = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if not _is_mutable_literal(value):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "# global-ok" in line:
+            continue
+        for target in targets:
+            if isinstance(target, ast.Name) and target.id != "__all__":
+                mutable_globals.add(target.id)
+    if not mutable_globals:
+        return []
+
+    findings: List[str] = []
+
+    def _hit(name: str, lineno: int, what: str) -> None:
+        findings.append(
+            f"{_rel(path)}:{lineno}: GLOBALMUT {what} mutates module "
+            f"global `{name}` without a lock — wrap in `with <lock>:` "
+            f"or allowlist the assignment with `# global-ok: <reason>`"
+        )
+
+    def _global_subscript(expr: ast.AST, local: set):
+        if (
+            isinstance(expr, ast.Subscript)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in mutable_globals
+            and expr.value.id not in local
+        ):
+            return expr.value.id
+        return None
+
+    def scan_node(node: ast.AST, local: set, lock_depth: int) -> None:
+        if lock_depth == 0:
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                func = node.func
+                if (
+                    isinstance(func.value, ast.Name)
+                    and func.value.id in mutable_globals
+                    and func.value.id not in local
+                    and func.attr in GLOBALMUT_MUTATORS
+                ):
+                    _hit(func.value.id, node.lineno, f"`.{func.attr}()`")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for target in targets:
+                    name = _global_subscript(target, local)
+                    if name is not None:
+                        _hit(name, node.lineno, "subscript assignment")
+            elif isinstance(node, ast.Delete):
+                for target in node.targets:
+                    name = _global_subscript(target, local)
+                    if name is not None:
+                        _hit(name, node.lineno, "`del` on subscript")
+        if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+            _lockish(item.context_expr) for item in node.items
+        ):
+            lock_depth += 1
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan_function(child, local, lock_depth)
+            elif isinstance(child, ast.Lambda):
+                continue  # expression-only: cannot contain mutations above
+            else:
+                scan_node(child, local, lock_depth)
+
+    def scan_function(fn: ast.AST, outer_local: set, lock_depth: int) -> None:
+        declared_global = {
+            name
+            for stmt in ast.walk(fn)
+            if isinstance(stmt, ast.Global)
+            for name in stmt.names
+        }
+        local = (outer_local | _bound_names(fn)) - declared_global
+        for stmt in fn.body:
+            scan_node(stmt, local, lock_depth)
+
+    def scan_class(cls: ast.AST) -> None:
+        for sub in cls.body:
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan_function(sub, set(), 0)
+            elif isinstance(sub, ast.ClassDef):
+                scan_class(sub)
+
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_function(stmt, set(), 0)
+        elif isinstance(stmt, ast.ClassDef):
+            scan_class(stmt)
     return findings
 
 
@@ -228,6 +431,10 @@ def main() -> int:
             rel == d or rel.startswith(d + os.sep) for d in TIMING_DIRS
         ):
             findings.extend(check_timing_calls(path))
+        if any(
+            rel == d or rel.startswith(d + os.sep) for d in GLOBALMUT_DIRS
+        ):
+            findings.extend(check_global_mutation(path))
 
     if shutil.which("ruff") is not None:
         findings.extend(run_ruff())
