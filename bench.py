@@ -38,6 +38,28 @@ Knobs (env):
                     timed end-to-end incl. jit compile — the methodology
                     behind BENCH_STREAM_100M/1B.json; adds rows/elapsed_s/
                     peak_rss_mb fields to the JSON line
+    BENCH_STREAM_SHAPE  "default" (6-col) | "wide" (50-col stream shape,
+                    build_wide_stream_table): the table the stream-mode
+                    parquet file holds. wide defaults BENCH_PARQUET to
+                    /tmp/bench_wide.parquet and measures a same-shape
+                    pandas denominator (BENCH_STREAM_1B_WIDE.json)
+    BENCH_PIPELINE_AB  "1" + mode=stream + BENCH_COLD=1: run the cold
+                    pass TWICE — DEEQU_TPU_PIPELINE=0 (fully serial:
+                    synchronous decode, inline prep) then =1 (staged
+                    pipeline) — dropping the OS page cache before each
+                    (best-effort, needs root) so both pay real disk IO.
+                    A traced pipelined warm-up pass runs first (jit +
+                    imports + the occupancy rows), then both timed
+                    passes run warm-jit/cold-IO and UNTRACED (equal
+                    footing). The JSON gains a pipeline_ab
+                    field: serial_s, pipelined_s, speedup, occupancy
+                    (bottleneck first). Headline value = PIPELINED pass
+    BENCH_SOURCE_STALL_MS  with BENCH_PIPELINE_AB: inject this many ms
+                    of source wait per row-group read into BOTH sides
+                    (DEEQU_TPU_SOURCE_STALL_MS; object-store latency
+                    model) — measures how much source wait the pipeline
+                    hides when local disk+readahead are too fast for
+                    decode/IO overlap to show
     BENCH_TRACE     "1" (or the --trace flag): after the timed reps, run
                      ONE extra traced pass (deequ_tpu.observe) — adds
                      trace_file plus a trace_phases_s breakdown
@@ -125,6 +147,45 @@ def build_wide_table(n_rows: int, seed: int = 0):
             data[f"i{i:02d}"] = rng.integers(0, 100 * (i + 1), n_rows)
         else:
             data[f"i{i:02d}"] = rng.integers(0, 10**9, n_rows)
+    for i in range(5):
+        data[f"b{i}"] = rng.random(n_rows) < (0.2 + 0.15 * i)
+    for i in range(10):
+        pool = CATEGORIES[: 3 + i]
+        data[f"s{i:02d}"] = pool[rng.integers(0, len(pool), n_rows)]
+    for i in range(5):
+        pool = np.array(
+            [str(v) for v in rng.integers(0, 2000 * (i + 1), 4096)],
+            dtype=object,
+        )
+        data[f"c{i}"] = pool[rng.integers(0, len(pool), n_rows)]
+    return Table.from_numpy(data)
+
+
+def build_wide_stream_table(n_rows: int, seed: int = 0):
+    """The 50-column wide shape for the OUT-OF-CORE stream bench: same
+    column mix as build_wide_table (floats / ints / bools / low-card
+    strings / numeric-strings) but with parquet-compact value
+    distributions — quantized decimals (integers/100, the TPC-H money
+    shape) and windowed ints, which dictionary-encode to ~1-2 bytes per
+    value. The in-memory wide shape's 20 continuous f64 columns alone
+    would make a 1B-row file ~160GB (incompressible entropy), which
+    does not fit this box; one column (f00) stays continuous lognormal
+    with nulls so the select-kernel family path rides the stream too."""
+    from deequ_tpu.data.table import Table
+
+    rng = np.random.default_rng(seed)
+    data = {}
+    f00 = rng.lognormal(2.0, 1.0, n_rows)
+    f00[rng.random(n_rows) < 0.03] = np.nan
+    data["f00"] = f00
+    for i in range(1, 20):
+        r = (200, 1_000, 2_000, 10_000)[i % 4]
+        data[f"f{i:02d}"] = rng.integers(0, r, n_rows) / 100.0
+    for i in range(10):
+        if i < 6:
+            data[f"i{i:02d}"] = rng.integers(0, 100 * (i + 1), n_rows)
+        else:
+            data[f"i{i:02d}"] = rng.integers(0, 50_000, n_rows)
     for i in range(5):
         data[f"b{i}"] = rng.random(n_rows) < (0.2 + 0.15 * i)
     for i in range(10):
@@ -254,7 +315,13 @@ def run_scan(table):
     return results
 
 
+def _stream_shape() -> str:
+    return os.environ.get("BENCH_STREAM_SHAPE", "default")
+
+
 def _builder_for_mode(mode: str):
+    if mode == "stream" and _stream_shape() == "wide":
+        return build_wide_stream_table
     return {
         "wide": build_wide_table,
         "lineitem": build_lineitem_table,
@@ -467,7 +534,9 @@ def _refresh_shape_json(shape: str, n_rows: int) -> None:
     )
 
 
-def write_parquet(n_rows: int, path: str, chunk: int = 2_000_000) -> None:
+def write_parquet(
+    n_rows: int, path: str, chunk: int = 2_000_000, builder=build_table
+) -> None:
     """Stream-generate the bench table to disk in chunks (bounded memory),
     so stream mode can exceed host RAM."""
     import pyarrow.parquet as pq
@@ -477,7 +546,7 @@ def write_parquet(n_rows: int, path: str, chunk: int = 2_000_000) -> None:
     seed = 0
     while done < n_rows:
         rows = min(chunk, n_rows - done)
-        at = build_table(rows, seed=seed).to_arrow()
+        at = builder(rows, seed=seed).to_arrow()
         if writer is None:
             writer = pq.ParquetWriter(path, at.schema)
         writer.write_table(at)
@@ -485,6 +554,19 @@ def write_parquet(n_rows: int, path: str, chunk: int = 2_000_000) -> None:
         seed += 1
     if writer is not None:
         writer.close()
+
+
+def _drop_page_cache() -> bool:
+    """Best-effort OS page-cache drop (needs root) so a cold stream pass
+    pays real disk IO instead of reading the just-written file from the
+    125GB host RAM. Returns whether it worked — recorded in the JSON so
+    a cached run is never mistaken for a disk-bound one."""
+    try:
+        with open("/proc/sys/vm/drop_caches", "w") as fh:
+            fh.write("3\n")
+        return True
+    except OSError:
+        return False
 
 
 def pallas_onchip_check() -> str:
@@ -568,11 +650,16 @@ def main() -> None:
 
         from deequ_tpu.data.table import Table
 
-        path = os.environ.get("BENCH_PARQUET", "/tmp/bench.parquet")
+        default_path = (
+            "/tmp/bench_wide.parquet"
+            if _stream_shape() == "wide"
+            else "/tmp/bench.parquet"
+        )
+        path = os.environ.get("BENCH_PARQUET", default_path)
         if not (
             os.path.exists(path) and pq.ParquetFile(path).metadata.num_rows == n_rows
         ):
-            write_parquet(n_rows, path)
+            write_parquet(n_rows, path, builder=_builder_for_mode("stream"))
         table = Table.scan_parquet(path)
     elif mode == "wide":
         table = build_wide_table(n_rows)
@@ -597,7 +684,9 @@ def main() -> None:
             baseline_note = "proxy"
         elif baseline_env == "measured":
             measured = _measure_baseline_subprocess(mode)
-            if mode in ("wide", "lineitem"):
+            if mode in ("wide", "lineitem") or (
+                mode == "stream" and _stream_shape() == "wide"
+            ):
                 # same-shape measured denominator; the 2.0M floor was
                 # calibrated for the 6-col table and would be absurdly
                 # generous per-row at 16-50 columns
@@ -625,10 +714,80 @@ def main() -> None:
         "1",
         "true",
     )
+    ab = cold and os.environ.get("BENCH_PIPELINE_AB", "") in ("1", "true")
     extra = {}
-    if cold:
+    if ab:
+        # pipeline A/B: the SAME cold pass twice — fully serial
+        # (DEEQU_TPU_PIPELINE=0: synchronous decode, inline prep) vs the
+        # staged pipeline — page cache dropped before each so both pay
+        # real disk IO. NEITHER timed pass is traced: tracing only the
+        # pipelined side was measured as a multi-percent thumb on the
+        # scale; the per-stage occupancy instead comes from the traced
+        # warm-up pass that runs before the timing. With
+        # BENCH_SOURCE_STALL_MS set, a per-row-group source stall
+        # (object-store latency model, deequ_tpu.ops.runtime
+        # .source_stall_s) applies identically to BOTH sides, measuring
+        # how much source wait the pipeline hides.
+        from deequ_tpu import observe
+
+        stall_ms = os.environ.get("BENCH_SOURCE_STALL_MS", "")
+        if stall_ms:
+            os.environ["DEEQU_TPU_SOURCE_STALL_MS"] = stall_ms
+        # warm-up pass FIRST (traced, pipelined): compiles every program
+        # and pays the one-time imports so neither timed pass rides the
+        # other's caches (serial-first was measured gifting the pipelined
+        # side ~0.7s of jit/import at 4M rows), and its span tree yields
+        # the per-stage occupancy rows. Both timed passes below are
+        # warm-jit, cold-IO, untraced.
+        with observe.tracing() as tracer:
+            run(table)
+        occupancy = observe.pipeline_occupancy(tracer.roots)
+        os.environ["DEEQU_TPU_PIPELINE"] = "0"
+        cache_dropped = _drop_page_cache()
+        t0 = time.perf_counter()
+        run(table)
+        serial_s = time.perf_counter() - t0
+        os.environ["DEEQU_TPU_PIPELINE"] = "1"
+        _drop_page_cache()
+        t0 = time.perf_counter()
+        run(table)
+        best = time.perf_counter() - t0
+        best_cpu = None
+        extra["pipeline_ab"] = {
+            "serial_s": round(serial_s, 1),
+            "pipelined_s": round(best, 1),
+            "speedup_pct": round(100.0 * (serial_s - best) / serial_s, 1),
+            "page_cache_dropped": cache_dropped,
+            **(
+                {"source_stall_ms": float(stall_ms)} if stall_ms else {}
+            ),
+            "occupancy_pass": (
+                "from the traced warm-up pass; both timed passes are "
+                "warm-jit, cold-IO, untraced"
+            ),
+            "occupancy": [
+                {
+                    "stage": row["stage"],
+                    "occupancy_pct": round(row["occupancy"] * 100, 1),
+                    "busy_s": round(row["busy_s"], 1),
+                    "stall_s": round(row["stall_s"], 1),
+                    "items": row["items"],
+                }
+                for row in occupancy
+            ],
+            "bottleneck": occupancy[0]["stage"] if occupancy else None,
+        }
+        print(
+            f"# bench: pipeline A/B serial={serial_s:.1f}s "
+            f"pipelined={best:.1f}s "
+            f"(+{100.0 * (serial_s - best) / serial_s:.1f}%), "
+            f"bottleneck={extra['pipeline_ab']['bottleneck']}",
+            file=sys.stderr,
+        )
+    elif cold:
         # the BENCH_STREAM_*.json methodology: ONE cold end-to-end pass
         # incl. jit compile; every stream batch decodes fresh either way
+        _drop_page_cache()
         t0 = time.perf_counter()
         run(table)
         best = time.perf_counter() - t0
@@ -679,11 +838,11 @@ def main() -> None:
 
     peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
     if cold:
-        extra = {
-            "rows": n_rows,
-            "elapsed_s": round(best, 1),
-            "peak_rss_mb": round(peak_rss_mb),
-        }
+        extra.update(
+            rows=n_rows,
+            elapsed_s=round(best, 1),
+            peak_rss_mb=round(peak_rss_mb),
+        )
     warm_note = "none (single cold pass)" if cold else f"{warm_s:.1f}s"
     print(
         f"# bench: mode={mode}{' (cold)' if cold else ''} rows={n_rows} "
@@ -722,7 +881,10 @@ def main() -> None:
 if __name__ == "__main__":
     if "--measure-baseline" in sys.argv:
         probe_mode = os.environ.get("BENCH_MODE", "profiler")
-        probe_rows = 2_000_000 if probe_mode not in ("wide",) else 500_000
+        wide_shape = probe_mode == "wide" or (
+            probe_mode == "stream" and _stream_shape() == "wide"
+        )
+        probe_rows = 500_000 if wide_shape else 2_000_000
         # best-of-3: the engine side is best-of-N timed reps, so the
         # baseline gets its best box phase too — a single-shot probe on
         # a drifting shared vCPU would randomly deflate the denominator
@@ -732,7 +894,9 @@ if __name__ == "__main__":
             for _ in range(3)
         )
         arrow_rate = 0.0
-        if probe_mode not in ("wide", "lineitem"):
+        # the Acero probe profiles the fixed 6-col shape: only a valid
+        # denominator when that IS the benched shape
+        if probe_mode not in ("wide", "lineitem") and not wide_shape:
             for _ in range(3):
                 try:
                     arrow_rate = max(

@@ -324,17 +324,21 @@ def _relative_error_param_check(relative_error: float) -> Callable[[Table], None
     return check
 
 
-import itertools
-
-# itertools.count.__next__ is atomic under the GIL: shard reducers may
-# run concurrently in the distributed pass's thread pool
-_BATCH_SEED_COUNTER = itertools.count(1)
+import zlib
 
 
-def _next_batch_seed() -> int:
-    """Distinct seed per batch sketch: KLL's error bound needs independent
-    compaction offsets across merged partials."""
-    return next(_BATCH_SEED_COUNTER)
+def _batch_seed(sample: np.ndarray, n: int, level: int) -> int:
+    """Deterministic per-batch sketch seed: KLL's error bound wants
+    compaction offsets that decorrelate across merged partials, and the
+    engine's differential contracts (pipeline on/off, engine parity,
+    repeated runs in one process) need bit-identical results. Hashing
+    the batch's own decimated sample gives both — distinct batches get
+    distinct offsets, while a scan's outcome depends only on its inputs
+    and fold order, never on which scans ran earlier in the process
+    (the old global counter made every run order-sensitive). Pure
+    function of the arguments: safe from concurrent shard reducers."""
+    h = zlib.crc32(np.ascontiguousarray(sample, dtype=np.float64).tobytes())
+    return (h ^ (int(n) * 0x9E3779B1) ^ (int(level) << 17)) & 0x7FFFFFFF
 
 
 class _QuantileAnalyzerBase(ScanShareableAnalyzer):
@@ -554,7 +558,7 @@ class _QuantileAnalyzerBase(ScanShareableAnalyzer):
         kept = max(0, -(-(n - offset) // stride))  # ceil((n-offset)/stride)
         sample = np.asarray(out["sample"], dtype=np.float64).reshape(-1)[:kept]
         k = k_for_error(self.relative_error)
-        sketch = KLLSketch(k=k, seed=_next_batch_seed())
+        sketch = KLLSketch(k=k, seed=_batch_seed(sample, n, level))
         sketch.insert_level(sample, level, true_count=n)
         partial = ApproxQuantileState(sketch)
         return partial if state is None else state.merge(partial)

@@ -17,13 +17,20 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from deequ_tpu.data.table import Column, ColumnType, NUMPY_BACKING, Table
+from deequ_tpu.observe import spans as _spans
 
 _SENTINEL = object()
+
+#: how long `batches()` waits for its decode thread at shutdown before
+#: abandoning it (the thread is a daemon; it can only still be alive if
+#: a single row-group decode takes longer than this)
+JOIN_TIMEOUT_S = 10.0
 
 
 def _arrow_ctype(t) -> ColumnType:
@@ -104,15 +111,36 @@ class DataSource:
 
     def batches(self, batch_size: int) -> Iterator[Table]:
         """Stream decoded Tables with a bounded prefetch thread: the next
-        batch's host decode overlaps the consumer's device compute.
+        batch's host decode overlaps the consumer's device compute. The
+        producer is the DECODE STAGE of the stream pipeline
+        (ops/pipeline.py): it adopts the consumer's trace context and
+        reports per-batch `pipe_item` spans under a `pipe_stage` span,
+        which the run report's pipeline-occupancy section aggregates.
 
-        Abandonment-safe: if the consumer drops the generator early (an
-        error mid-pass), the finally block signals the producer, drains
-        the queue so its blocked put() wakes, and joins the thread — no
-        stuck threads or open file handles accumulate."""
+        Abandonment-safe (pinned by tests/test_pipeline_shutdown.py): if
+        the consumer drops the generator early (an error mid-pass, a
+        downstream stage shutting down), the finally block signals the
+        producer, drains the queue so its blocked put() wakes, and joins
+        the thread within JOIN_TIMEOUT_S. The producer closes its
+        `_iter_tables` iterator ON the producer thread before exiting,
+        so file handles (e.g. the open ParquetFile) release
+        deterministically rather than at garbage collection.
+
+        `DEEQU_TPU_PIPELINE=0` (runtime.pipeline_enabled) decodes
+        synchronously on the caller's thread instead — no prefetch
+        thread, no queue: the fully SERIAL fallback the stream
+        pipeline's differential tests compare against. Batch content
+        and order are identical either way."""
+        from deequ_tpu.ops import runtime
+
+        if not runtime.pipeline_enabled():
+            yield from self._batches_serial(batch_size)
+            return
         q: "queue.Queue" = queue.Queue(maxsize=2)
         stop = threading.Event()
         error: List[BaseException] = []
+        tracer = _spans.current_tracer()
+        parent = _spans.current_span()
 
         def _put(item) -> bool:
             while not stop.is_set():
@@ -124,16 +152,56 @@ class DataSource:
             return False
 
         def producer() -> None:
+            it = self._iter_tables(batch_size)
+
+            def _next():
+                try:
+                    return next(it)
+                except StopIteration:
+                    return _SENTINEL
+
             try:
-                for table in self._iter_tables(batch_size):
-                    if not _put(table):
-                        return
+                with _spans.attached(tracer, parent):
+                    with _spans.span(
+                        "pipe_stage", cat="pipeline", stage="decode"
+                    ) as stage_sp:
+                        items = 0
+                        while not stop.is_set():
+                            sp = _spans.span(
+                                "pipe_item", cat="pipeline", stage="decode"
+                            )
+                            with sp:
+                                table = _next()
+                                if sp:
+                                    # the exhausted fetch still runs the
+                                    # iterator's tail (flush + close) —
+                                    # real decode time, but not an item
+                                    if table is _SENTINEL:
+                                        sp.set(eos=True)
+                                    else:
+                                        sp.set(rows=int(table.num_rows))
+                            if table is _SENTINEL:
+                                break
+                            if not _put(table):
+                                return
+                            items += 1
+                        if stage_sp:
+                            stage_sp.set(items=items)
             except BaseException as e:  # noqa: BLE001
                 error.append(e)
             finally:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except BaseException as e:  # noqa: BLE001
+                        if not error:
+                            error.append(e)
                 _put(_SENTINEL)
 
-        thread = threading.Thread(target=producer, daemon=True)
+        thread = threading.Thread(
+            target=producer, daemon=True, name="deequ-decode"
+        )
         thread.start()
         produced_any = False
         try:
@@ -150,13 +218,29 @@ class DataSource:
                     q.get_nowait()
             except queue.Empty:
                 pass
-            thread.join(timeout=10)
+            thread.join(timeout=JOIN_TIMEOUT_S)
         if error:
             raise error[0]
         if not produced_any:
             # zero-row source: one empty batch so aggregations see the
             # schema and produce their empty-state verdicts, matching the
             # in-memory Table contract
+            yield Table([_empty_column(n, t) for n, t in self._schema()])
+
+    def _batches_serial(self, batch_size: int) -> Iterator[Table]:
+        """The DEEQU_TPU_PIPELINE=0 decode: same iterator, same batch
+        sequence, same empty-batch fallback — on the calling thread."""
+        produced_any = False
+        it = self._iter_tables(batch_size)
+        try:
+            for table in it:
+                produced_any = True
+                yield table
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+        if not produced_any:
             yield Table([_empty_column(n, t) for n, t in self._schema()])
 
 
@@ -203,6 +287,8 @@ class ParquetSource(DataSource):
     def _iter_tables(self, batch_size: int) -> Iterator[Table]:
         import pyarrow.parquet as pq
 
+        from deequ_tpu.ops import runtime
+
         size = min(batch_size, self.batch_rows)
         # Read row group by row group: this pyarrow's iter_batches /
         # dataset scanner retain every decoded batch in the pool for the
@@ -236,6 +322,10 @@ class ParquetSource(DataSource):
             tiny = max(1, size // 4)
             pending: list = []
             pending_rows = 0
+            # benchmark-only latency injection (object-store model):
+            # sleeps on the decoding thread before each row-group read,
+            # i.e. exactly where a remote range-GET would block
+            stall_s = runtime.source_stall_s()
 
             def flush():
                 if not pending:
@@ -249,6 +339,8 @@ class ParquetSource(DataSource):
                 return merged
 
             for g in range(pf.metadata.num_row_groups):
+                if stall_s > 0.0:
+                    time.sleep(stall_s)
                 group = pf.read_row_group(g, columns=self.columns)
                 if group.num_rows < tiny:
                     pending.append(group)

@@ -560,10 +560,23 @@ class Table:
         import pyarrow as pa
 
         cols = []
+        all_true = None  # one read-only mask shared by every null-free column
         for name in arrow_table.column_names:
-            arr = arrow_table.column(name).combine_chunks()
-            if isinstance(arr, pa.ChunkedArray):
-                arr = arr.chunk(0) if arr.num_chunks else pa.array([], arr.type)
+            chunked = arrow_table.column(name)
+            if isinstance(chunked, pa.ChunkedArray):
+                # single-chunk columns (every row-group/slice read) skip
+                # the combine_chunks memcpy; the chunk may carry a slice
+                # offset, which every consumer below handles
+                if chunked.num_chunks == 1:
+                    arr = chunked.chunk(0)
+                elif chunked.num_chunks == 0:
+                    arr = pa.array([], chunked.type)
+                else:
+                    arr = chunked.combine_chunks()
+                    if isinstance(arr, pa.ChunkedArray):
+                        arr = arr.chunk(0)
+            else:
+                arr = chunked
             if pa.types.is_dictionary(arr.type) and not (
                 pa.types.is_string(arr.type.value_type)
                 or pa.types.is_large_string(arr.type.value_type)
@@ -574,13 +587,16 @@ class Table:
                 arr = arr.dictionary_decode()
             # null-free columns skip the fill_null/where copies and get
             # zero-copy numpy views of the arrow buffers where possible
-            # (views are read-only; Column treats values as immutable)
+            # (views are read-only; Column treats values as immutable,
+            # which also lets all null-free columns share one mask)
             no_nulls = arr.null_count == 0
-            valid = (
-                np.ones(len(arr), dtype=bool)
-                if no_nulls
-                else np.asarray(arr.is_valid())
-            )
+            if no_nulls:
+                if all_true is None or len(all_true) != len(arr):
+                    all_true = np.ones(len(arr), dtype=bool)
+                    all_true.setflags(write=False)
+                valid = all_true
+            else:
+                valid = np.asarray(arr.is_valid())
             t = arr.type
             if pa.types.is_boolean(t):
                 vals = np.asarray(arr if no_nulls else arr.fill_null(False))

@@ -103,6 +103,51 @@ class PassCost:
     notes: Tuple[str, ...] = ()
 
 
+#: stated host-side throughput for the decode+prep stages of the stream
+#: pipeline (Arrow decode + wire pack are memcpy-shaped): used to turn
+#: read bytes/batch into a host seconds/batch for the overlap model.
+PIPELINE_HOST_BYTES_PER_S = 2e9
+
+
+@dataclass
+class PipelineCost:
+    """Predicted shape of the backpressured stream pipeline
+    (ops/pipeline.py) for the scan pass: per-batch stage costs under the
+    stated overlap model, and whether the configured queue depth can
+    hide the measured H2D transfer latency.
+
+    Model: decode+prep host work per batch is `read_bytes / batch` at
+    `PIPELINE_HOST_BYTES_PER_S` (stated constant); the H2D wire time is
+    the exact packed first-batch bytes over the measured link bandwidth
+    (the same disk-cached probe the placement policy uses, or an
+    injected `link_bandwidth`). Serially those costs add; pipelined, the
+    critical path is the slowest stage — the overlap-adjusted cost. With
+    queue depth d the prep stage can run at most d batches ahead, so a
+    single transfer outlasting d batches of host work starves the fold
+    stage no matter how the stages interleave (the DQ305 condition)."""
+
+    enabled: bool
+    queue_depth: int
+    stages: Tuple[str, ...] = ("decode", "prep", "fold")
+    n_batches: int = 1
+    wire_bytes_per_batch: Optional[int] = None
+    link_bandwidth: Optional[float] = None  # bytes/s; None = unmeasured
+    host_s_per_batch: Optional[float] = None
+    wire_s_per_batch: Optional[float] = None
+    serial_s_per_batch: Optional[float] = None
+    overlapped_s_per_batch: Optional[float] = None
+    bottleneck: Optional[str] = None  # 'host' | 'transfer'
+
+    @property
+    def depth_hides_transfer(self) -> Optional[bool]:
+        """False when one batch's H2D transfer outlasts `queue_depth`
+        batches of host work — the queue drains and the fold stage
+        starves. None when either side is unmeasured."""
+        if self.wire_s_per_batch is None or self.host_s_per_batch is None:
+            return None
+        return self.wire_s_per_batch <= self.queue_depth * self.host_s_per_batch
+
+
 @dataclass
 class PlanCost:
     """Machine-readable prediction of a plan's execution shape."""
@@ -120,6 +165,9 @@ class PlanCost:
     span_counts: Dict[str, int] = field(default_factory=dict)
     num_hosts: int = 1
     allgather_rounds: int = 0
+    #: stream-pipeline prediction for the scan pass; None for
+    #: non-streaming plans (in-memory tables never engage the pipeline)
+    pipeline: Optional[PipelineCost] = None
 
     @property
     def total_read_bytes_per_row(self) -> float:
@@ -226,11 +274,19 @@ def analyze_plan(
     engine: str = "single",
     num_hosts: int = 1,
     num_devices: int = 1,
+    streaming: bool = False,
+    link_bandwidth: Optional[float] = None,
+    pipeline_depth: Optional[int] = None,
 ) -> PlanCost:
     """Abstract interpretation of `AnalysisRunner._do_analysis_run`:
     dedupe -> static precondition filtering (zero-row table) ->
     grouping/scanning split -> the pure scan planner -> batching and
-    wire math. Pure: no kernel is compiled, no row is read."""
+    wire math. Pure: no kernel is compiled, no row is read.
+
+    `streaming=True` additionally predicts the stream pipeline's shape
+    (`PlanCost.pipeline`): per-batch host vs wire seconds under the
+    stated overlap model, with the link bandwidth taken from
+    `link_bandwidth` or the disk-cached placement probe."""
     from deequ_tpu.analyzers.base import Preconditions, ScanShareableAnalyzer
     from deequ_tpu.analyzers.frequency import (
         FrequencyBasedAnalyzer,
@@ -368,6 +424,45 @@ def analyze_plan(
         )
         cost.passes.append(scan_pass)
 
+        if streaming:
+            depth = (
+                pipeline_depth
+                if pipeline_depth is not None
+                else runtime.pipeline_depth()
+            )
+            bw = link_bandwidth
+            if bw is None and use_device:
+                bw = runtime._load_bandwidth_from_disk()
+            read_per_batch = scan_pass.read_bytes_per_row * first_rows
+            host_s = (
+                read_per_batch / PIPELINE_HOST_BYTES_PER_S
+                if read_per_batch > 0
+                else None
+            )
+            if not use_device:
+                wire_s: Optional[float] = 0.0
+            elif wire_exact is not None and bw:
+                wire_s = wire_exact / float(bw)
+            else:
+                wire_s = None  # data-dependent wire or unmeasured link
+            serial = overlapped = bottleneck = None
+            if host_s is not None and wire_s is not None:
+                serial = host_s + wire_s
+                overlapped = max(host_s, wire_s)
+                bottleneck = "transfer" if wire_s > host_s else "host"
+            cost.pipeline = PipelineCost(
+                enabled=runtime.pipeline_enabled(),
+                queue_depth=depth,
+                n_batches=batches,
+                wire_bytes_per_batch=wire_exact if use_device else 0,
+                link_bandwidth=bw,
+                host_s_per_batch=host_s,
+                wire_s_per_batch=wire_s,
+                serial_s_per_batch=serial,
+                overlapped_s_per_batch=overlapped,
+                bottleneck=bottleneck,
+            )
+
         if plan.any_members:
             counters["device_passes"] += 1
             spans["host_fold"] += batches
@@ -483,8 +578,10 @@ def analyze_plan(
 __all__ = [
     "COUNTERS",
     "EXECUTION_SPANS",
+    "PIPELINE_HOST_BYTES_PER_S",
     "FamilyGroupCost",
     "PassCost",
+    "PipelineCost",
     "PlanCost",
     "analyze_plan",
 ]

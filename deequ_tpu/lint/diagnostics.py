@@ -45,6 +45,7 @@ CODES = {
     "DQ302": "cap/cardinality blowup",
     "DQ303": "per-pass working set exceeds the cache-tile budget",
     "DQ304": "transfer-per-row anti-pattern",
+    "DQ305": "pipeline queue depth cannot hide the measured transfer latency",
 }
 
 

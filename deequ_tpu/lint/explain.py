@@ -184,6 +184,35 @@ def cost_diagnostics(
                 "latency dominates the wire time — raise batch_size",
             )
         )
+
+    # DQ305 — the stream pipeline's queue depth cannot hide the measured
+    # H2D transfer latency: one batch's wire time exceeds `depth` batches
+    # of host (decode+prep) work, so however the stages interleave the
+    # fold stage starves on transfer (cost.PipelineCost overlap model)
+    pipe = cost.pipeline
+    if (
+        pipe is not None
+        and pipe.enabled
+        and scan is not None
+        and scan.device_members > 0
+        and scan.n_batches > 1
+        and pipe.depth_hides_transfer is False
+    ):
+        diags.append(
+            Diagnostic(
+                "DQ305",
+                Severity.WARNING,
+                f"stream-pipeline queue depth {pipe.queue_depth} cannot "
+                f"hide the measured H2D transfer: one batch's wire time "
+                f"(~{pipe.wire_s_per_batch:.3g}s at the measured "
+                f"{pipe.link_bandwidth:.3g} B/s link) exceeds "
+                f"{pipe.queue_depth}x the per-batch host work "
+                f"(~{pipe.host_s_per_batch:.3g}s) — raise "
+                "DEEQU_TPU_PIPELINE_DEPTH or batch_size, or shed wire "
+                "bytes (host placement folds discrete members without "
+                "a transfer)",
+            )
+        )
     return diags
 
 
@@ -259,6 +288,26 @@ def render_explain(
         body.extend(_render_pass(p, i))
     if not cost.passes:
         body.append("(no passes: nothing to compute)")
+    pipe = cost.pipeline
+    if pipe is not None:
+        state = "on" if pipe.enabled else "off (DEEQU_TPU_PIPELINE=0)"
+        body.append(
+            f"stream pipeline: {state}   depth: {pipe.queue_depth}   "
+            f"stages: {' > '.join(pipe.stages)}"
+        )
+        if pipe.serial_s_per_batch is not None:
+            body.append(
+                f"  per-batch: host ~{pipe.host_s_per_batch:.3g}s "
+                f"+ wire ~{pipe.wire_s_per_batch:.3g}s  ->  "
+                f"overlapped ~{pipe.overlapped_s_per_batch:.3g}s "
+                f"(serial ~{pipe.serial_s_per_batch:.3g}s, "
+                f"bottleneck: {pipe.bottleneck})"
+            )
+        elif pipe.wire_s_per_batch is None and pipe.wire_bytes_per_batch:
+            body.append(
+                "  per-batch wire time unmeasured "
+                "(no cached link-bandwidth probe)"
+            )
     sig = cost.dispatch_signature()
     body.append(
         "predicted counters: "
@@ -321,15 +370,25 @@ def explain_plan(
     engine: str = "single",
     num_hosts: int = 1,
     num_devices: int = 1,
+    streaming: Optional[bool] = None,
+    link_bandwidth: Optional[float] = None,
+    pipeline_depth: Optional[int] = None,
 ) -> ExplainResult:
     """EXPLAIN an analysis plan against a `Table` (schema and row count
-    are taken from it — still zero data scanned) or a `SchemaInfo`."""
+    are taken from it — still zero data scanned) or a `SchemaInfo`.
+
+    `streaming` defaults to the table's own `is_streaming` (False for a
+    bare `SchemaInfo`); streaming plans additionally predict the stream
+    pipeline's overlap shape and the DQ305 queue-depth lint, with the
+    link bandwidth from `link_bandwidth` or the cached placement probe."""
     if isinstance(data_or_schema, SchemaInfo):
         schema = data_or_schema
     else:
         schema = SchemaInfo.from_table(data_or_schema)
         if num_rows is None:
             num_rows = int(data_or_schema.num_rows)
+        if streaming is None:
+            streaming = bool(getattr(data_or_schema, "is_streaming", False))
     plan = _plan_analyzers(analyzers, checks)
     cost = analyze_plan(
         plan,
@@ -340,6 +399,9 @@ def explain_plan(
         engine=engine,
         num_hosts=num_hosts,
         num_devices=num_devices,
+        streaming=bool(streaming),
+        link_bandwidth=link_bandwidth,
+        pipeline_depth=pipeline_depth,
     )
     return ExplainResult(
         cost=cost, diagnostics=cost_diagnostics(cost, plan, schema)

@@ -44,7 +44,14 @@ from deequ_tpu.observe.compare import (
     observed_family_groups,
     span_name_counts,
 )
-from deequ_tpu.observe.report import PHASES, phase_seconds, render_report
+from deequ_tpu.observe.report import (
+    PHASES,
+    PIPE_ITEM_SPAN,
+    PIPE_STAGE_SPAN,
+    phase_seconds,
+    pipeline_occupancy,
+    render_report,
+)
 from deequ_tpu.observe.runtrace import (
     ENV_KNOB,
     ENV_OUT,
@@ -69,7 +76,10 @@ __all__ = [
     "merge_chrome_traces",
     "write_chrome_trace",
     "PHASES",
+    "PIPE_ITEM_SPAN",
+    "PIPE_STAGE_SPAN",
     "phase_seconds",
+    "pipeline_occupancy",
     "render_report",
     "ENV_KNOB",
     "ENV_OUT",

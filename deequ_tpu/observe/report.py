@@ -24,6 +24,13 @@ from deequ_tpu.observe.spans import Span, Tracer
 # when spans carry them.
 PHASES = ("plan", "dispatch", "transfer", "merge")
 
+# Stream-pipeline span vocabulary (ops/pipeline.py, data/source.py):
+# one PIPE_STAGE_SPAN per stage-thread lifetime, one PIPE_ITEM_SPAN
+# child per batch of actual stage work. Wall minus the items' busy time
+# is stall — waiting on a queue, i.e. on another stage.
+PIPE_STAGE_SPAN = "pipe_stage"
+PIPE_ITEM_SPAN = "pipe_item"
+
 Roots = Union[Span, Tracer, Sequence[Span]]
 
 
@@ -50,6 +57,59 @@ def phase_seconds(roots: Roots) -> Dict[str, float]:
     for root in _roots_of(roots):
         visit(root)
     return buckets
+
+
+def pipeline_occupancy(roots: Roots) -> List[Dict[str, Any]]:
+    """Aggregate stream-pipeline stage utilisation from the span forest.
+
+    For every `pipe_stage` span (one per stage-thread lifetime), its
+    `pipe_item` children are the stage's actual per-batch work; the
+    rest of the stage's wall is stall — blocked on an inter-stage queue,
+    i.e. waiting for another stage. Returns one row per stage name:
+
+        {stage, wall_s, busy_s, stall_s, occupancy, items}
+
+    sorted by busy_s descending, so row 0 is the pipeline's bottleneck
+    stage (the one the other stages stall on). Pure function of the
+    spans; the same rows back `render_report`'s pipeline section and
+    the bench artifacts' occupancy breakdown. Empty when the run never
+    engaged the pipeline (serial fallback, in-memory tables)."""
+    rows: Dict[str, Dict[str, Any]] = {}
+    order: List[str] = []
+
+    def visit(span: Span) -> None:
+        if span.name == PIPE_STAGE_SPAN:
+            stage = str(span.attrs.get("stage", "?"))
+            row = rows.get(stage)
+            if row is None:
+                row = rows[stage] = {
+                    "stage": stage, "wall_s": 0.0, "busy_s": 0.0, "items": 0,
+                }
+                order.append(stage)
+            row["wall_s"] += span.duration_s
+            for child in span.children:
+                if child.name != PIPE_ITEM_SPAN:
+                    continue
+                # the eos item is the decode tail (flush + close): real
+                # stage time, but not a delivered batch
+                row["busy_s"] += child.duration_s
+                if not child.attrs.get("eos"):
+                    row["items"] += 1
+        for child in span.children:
+            visit(child)
+
+    for root in _roots_of(roots):
+        visit(root)
+    out = []
+    for stage in order:
+        row = rows[stage]
+        row["stall_s"] = max(row["wall_s"] - row["busy_s"], 0.0)
+        row["occupancy"] = (
+            row["busy_s"] / row["wall_s"] if row["wall_s"] > 0 else 0.0
+        )
+        out.append(row)
+    out.sort(key=lambda r: -r["busy_s"])
+    return out
 
 
 def _fmt_attr(value: Any) -> str:
@@ -152,6 +212,17 @@ def render_report(
         for i, (child, n, secs) in enumerate(grouped):
             _render_span(
                 child, n, secs, "", i == len(grouped) - 1, lines, 1, max_depth
+            )
+    occupancy = pipeline_occupancy(root_list)
+    if occupancy:
+        lines.append("pipeline occupancy (busy/wall per stage):")
+        for i, row in enumerate(occupancy):
+            marker = "  <- bottleneck" if i == 0 else ""
+            lines.append(
+                f"  {row['stage']:<8} {row['occupancy'] * 100:5.1f}%"
+                f"  busy {row['busy_s']:.3f}s"
+                f"  stall {row['stall_s']:.3f}s"
+                f"  items {row['items']}{marker}"
             )
     phases = phase_seconds(root_list)
     phase_text = " | ".join(

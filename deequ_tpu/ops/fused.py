@@ -15,6 +15,7 @@ role the reference's `State.sum` plays after Catalyst partial aggregation.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 from dataclasses import dataclass, field
@@ -28,7 +29,7 @@ from deequ_tpu import observe
 from deequ_tpu.analyzers.base import ScanShareableAnalyzer
 from deequ_tpu.analyzers.states import State
 from deequ_tpu.data.table import Table
-from deequ_tpu.ops import runtime
+from deequ_tpu.ops import pipeline, runtime
 
 DEFAULT_BATCH_SIZE = 1 << 22  # 4M rows: < 2^24 so f32 counts stay exact
 
@@ -556,6 +557,7 @@ def fold_host_batch(
     batch=None,
     streaming: bool = False,
     family_memo: Optional[Dict] = None,
+    precomputed: bool = False,
 ) -> None:
     """One batch's host-placed fold, shared by FusedScanPass and
     DistributedScanPass: merge members run their xp-generic reduce with
@@ -563,16 +565,20 @@ def fold_host_batch(
     the device would (sort+decimate) and fold via host_consume. A failed
     input fails only the members that need it. `family_memo` is a dict
     the caller keeps alive for the whole scan: cross-batch facts (e.g.
-    which columns miss the counts shortcut) persist across batches."""
-    _precompute_family_kernels(
-        built,
-        host_assisted,
-        batch,
-        host_members=host_members,
-        host_errors=host_errors,
-        streaming=streaming,
-        family_memo=family_memo,
-    )
+    which columns miss the counts shortcut) persist across batches.
+    `precomputed=True` skips the family-kernel precompute — the stream
+    pipeline's prep stage (ops/pipeline.py) already ran it off the fold
+    stage's critical path and its memos sit in `built`."""
+    if not precomputed:
+        _precompute_family_kernels(
+            built,
+            host_assisted,
+            batch,
+            host_members=host_members,
+            host_errors=host_errors,
+            streaming=streaming,
+            family_memo=family_memo,
+        )
     # assisted members fold FIRST: some publish per-batch memos that
     # merge members answer from (e.g. _LowCardCounts' dictionary
     # presence serving ApproxCountDistinct)
@@ -1210,72 +1216,81 @@ class FusedScanPass:
             # machinery and sketch folds. Capped at ~16M rows so
             # worst-case kernel scratch stays bounded.
             batch_size = max(batch_size, min(table.num_rows, 1 << 24))
-        for batch in table.batches(batch_size):
-            # per-key builds with error capture: a failing input (e.g. a
-            # predicate over a missing column) fails only the analyzers
-            # that need it — host members individually, the device group
-            # as a whole (reference: AnalysisRunner.scala:310-313).
-            # Only keys with a still-live consumer are built at all.
-            live_keys: set = set()
-            if use_device and device_error is None:
-                live_keys.update(device_spec_keys)
-            for i, _member in all_host:
-                if i not in host_errors:
-                    live_keys.update(host_member_keys[i])
-            device_live = use_device and device_error is None
-            host_live = any(i not in host_errors for i, _m in all_host)
-            if not device_live and not host_live:
-                break  # everything already failed; stop scanning
-            # device keys build eagerly (the shared program needs them
-            # packed); host-only keys build lazily on first member access
-            built = HostInputs(specs, batch)
-            build_errors = built.build_errors
-            if device_live:
-                for key in device_spec_keys:
-                    built.materialize(key)
-            if use_device and device_error is None:
-                try:
-                    with observe.span(
-                        "dispatch", cat="dispatch", rows=batch.num_rows
-                    ) as dispatch_sp:
-                        for key in device_spec_keys:
-                            if key in build_errors:
-                                raise build_errors[key]
-                        padded = _pad_size(batch.num_rows, self.batch_size)
-                        packed_inputs, layout = pack_batch_inputs(
-                            [(k, built[k]) for k in device_spec_keys],
-                            padded, dtype, sticky, num_rows=batch.num_rows,
-                        )
-                        if dispatch_sp:
-                            dispatch_sp.set(
-                                wire_bytes=int(
-                                    sum(
-                                        int(getattr(v, "nbytes", 0))
-                                        for v in packed_inputs.values()
+        if streaming and runtime.pipeline_enabled():
+            scanned_rows, scanned_batches, device_error = self._scan_pipelined(
+                table, batch_size, analyzers, assisted, specs,
+                device_spec_keys, use_device, dtype, sticky, fold,
+                host_members, host_assisted, host_member_keys,
+                host_aggs, host_assisted_states, host_errors, family_memo,
+            )
+        else:
+            for batch in table.batches(batch_size):
+                # per-key builds with error capture: a failing input (e.g.
+                # a predicate over a missing column) fails only the
+                # analyzers that need it — host members individually, the
+                # device group as a whole (reference:
+                # AnalysisRunner.scala:310-313). Only keys with a
+                # still-live consumer are built at all.
+                live_keys: set = set()
+                if use_device and device_error is None:
+                    live_keys.update(device_spec_keys)
+                for i, _member in all_host:
+                    if i not in host_errors:
+                        live_keys.update(host_member_keys[i])
+                device_live = use_device and device_error is None
+                host_live = any(i not in host_errors for i, _m in all_host)
+                if not device_live and not host_live:
+                    break  # everything already failed; stop scanning
+                # device keys build eagerly (the shared program needs them
+                # packed); host-only keys build lazily on member access
+                built = HostInputs(specs, batch)
+                build_errors = built.build_errors
+                if device_live:
+                    for key in device_spec_keys:
+                        built.materialize(key)
+                if use_device and device_error is None:
+                    try:
+                        with observe.span(
+                            "dispatch", cat="dispatch", rows=batch.num_rows
+                        ) as dispatch_sp:
+                            for key in device_spec_keys:
+                                if key in build_errors:
+                                    raise build_errors[key]
+                            padded = _pad_size(batch.num_rows, self.batch_size)
+                            packed_inputs, layout = pack_batch_inputs(
+                                [(k, built[k]) for k in device_spec_keys],
+                                padded, dtype, sticky, num_rows=batch.num_rows,
+                            )
+                            if dispatch_sp:
+                                dispatch_sp.set(
+                                    wire_bytes=int(
+                                        sum(
+                                            int(getattr(v, "nbytes", 0))
+                                            for v in packed_inputs.values()
+                                        )
                                     )
                                 )
+                            fused, meta_box = get_fused_fn(
+                                analyzers, assisted, layout
                             )
-                        fused, meta_box = get_fused_fn(
-                            analyzers, assisted, layout
-                        )
-                        runtime.record_launch()
-                        # async dispatch: the device crunches this batch
-                        # while the host folds the previous batch (and
-                        # the host members below)
-                        fold.submit(
-                            fused(packed_inputs), meta_box, host_ctx=built
-                        )
-                except Exception as e:  # noqa: BLE001
-                    device_error = e
-            with observe.span("host_fold", cat="host", rows=batch.num_rows):
-                fold_host_batch(
-                    built, build_errors, host_members, host_assisted,
-                    host_member_keys, host_aggs, host_assisted_states,
-                    host_errors, batch=batch, streaming=streaming,
-                    family_memo=family_memo,
-                )
-            scanned_rows += batch.num_rows
-            scanned_batches += 1
+                            runtime.record_launch()
+                            # async dispatch: the device crunches this
+                            # batch while the host folds the previous
+                            # batch (and the host members below)
+                            fold.submit(
+                                fused(packed_inputs), meta_box, host_ctx=built
+                            )
+                    except Exception as e:  # noqa: BLE001
+                        device_error = e
+                with observe.span("host_fold", cat="host", rows=batch.num_rows):
+                    fold_host_batch(
+                        built, build_errors, host_members, host_assisted,
+                        host_member_keys, host_aggs, host_assisted_states,
+                        host_errors, batch=batch, streaming=streaming,
+                        family_memo=family_memo,
+                    )
+                scanned_rows += batch.num_rows
+                scanned_batches += 1
 
         observe.annotate(rows=scanned_rows, batches=scanned_batches)
         aggs, assisted_states = [], []
@@ -1296,3 +1311,139 @@ class FusedScanPass:
             host_members, host_assisted, host_aggs, host_assisted_states, host_errors
         )
         return aggs, assisted_states, host_results, device_error
+
+    def _scan_pipelined(
+        self,
+        table,
+        batch_size,
+        analyzers,
+        assisted,
+        specs,
+        device_spec_keys,
+        use_device,
+        dtype,
+        sticky,
+        fold,
+        host_members,
+        host_assisted,
+        host_member_keys,
+        host_aggs,
+        host_assisted_states,
+        host_errors,
+        family_memo,
+    ):
+        """The pipelined streaming consumer loop (`DEEQU_TPU_PIPELINE`):
+        per-batch prep — eager device-key builds, wire packing with its
+        H2D put, family kernels — runs on a dedicated stage thread
+        (ops/pipeline.py) ahead of this, the fold stage, which keeps
+        every state mutation (`fold.submit` merges, `fold_host_batch`)
+        in batch order on one thread. Fold order, fold inputs, and the
+        single-threaded sticky-dict mutation are exactly the serial
+        path's, so metrics are bit-identical; only WHERE the prep work
+        runs changes. Liveness feedback to the prep stage (a failed
+        device program, dead host members) lags by the queue depth —
+        in-flight batches may prep work the fold stage then ignores."""
+        all_host = list(host_members) + list(host_assisted)
+        # prep-visible mirror of device_error: set either by a pack
+        # failure on the prep thread or a dispatch/runtime failure here,
+        # so in-flight batches stop paying for device packing
+        device_down = threading.Event()
+
+        def _prep(batch):
+            built = HostInputs(specs, batch)
+            packed_inputs = layout = device_exc = None
+            if use_device and not device_down.is_set():
+                for key in device_spec_keys:
+                    built.materialize(key)
+                try:
+                    with observe.span(
+                        "dispatch", cat="dispatch", rows=batch.num_rows
+                    ) as dispatch_sp:
+                        for key in device_spec_keys:
+                            if key in built.build_errors:
+                                raise built.build_errors[key]
+                        padded = _pad_size(batch.num_rows, self.batch_size)
+                        # the H2D put happens HERE (jnp.asarray inside):
+                        # batch N+1's wire lands device-side while the
+                        # fold stage still runs batch N
+                        packed_inputs, layout = pack_batch_inputs(
+                            [(k, built[k]) for k in device_spec_keys],
+                            padded, dtype, sticky, num_rows=batch.num_rows,
+                        )
+                        if dispatch_sp:
+                            dispatch_sp.set(
+                                wire_bytes=int(
+                                    sum(
+                                        int(getattr(v, "nbytes", 0))
+                                        for v in packed_inputs.values()
+                                    )
+                                )
+                            )
+                except Exception as e:  # noqa: BLE001
+                    device_exc = e
+                    packed_inputs = layout = None
+                    device_down.set()
+            if any(i not in host_errors for i, _m in all_host):
+                with observe.span(
+                    "host_prep", cat="host", rows=batch.num_rows
+                ):
+                    _precompute_family_kernels(
+                        built, host_assisted, batch,
+                        host_members=host_members, host_errors=host_errors,
+                        streaming=True, family_memo=family_memo,
+                    )
+            return batch, built, packed_inputs, layout, device_exc
+
+        scanned_rows = 0
+        scanned_batches = 0
+        device_error: Optional[BaseException] = None
+        items = pipeline.staged(table.batches(batch_size), _prep, name="prep")
+        with contextlib.closing(items):
+            with observe.span(
+                "pipe_stage", cat="pipeline", stage="fold"
+            ) as stage_sp:
+                for item in items:
+                    batch, built, packed_inputs, layout, device_exc = item
+                    device_live = use_device and device_error is None
+                    host_live = any(i not in host_errors for i, _m in all_host)
+                    if not device_live and not host_live:
+                        break  # everything already failed; stop scanning
+                    with observe.span(
+                        "pipe_item", cat="pipeline", stage="fold",
+                        rows=batch.num_rows,
+                    ):
+                        if device_live:
+                            if device_exc is not None:
+                                device_error = device_exc
+                            elif packed_inputs is not None:
+                                try:
+                                    fused, meta_box = get_fused_fn(
+                                        analyzers, assisted, layout
+                                    )
+                                    runtime.record_launch()
+                                    # async dispatch; submit folds the
+                                    # PREVIOUS batch (async D2H landed)
+                                    # while the device crunches this one
+                                    fold.submit(
+                                        fused(packed_inputs), meta_box,
+                                        host_ctx=built,
+                                    )
+                                except Exception as e:  # noqa: BLE001
+                                    device_error = e
+                            if device_error is not None:
+                                device_down.set()
+                        with observe.span(
+                            "host_fold", cat="host", rows=batch.num_rows
+                        ):
+                            fold_host_batch(
+                                built, built.build_errors, host_members,
+                                host_assisted, host_member_keys, host_aggs,
+                                host_assisted_states, host_errors,
+                                batch=batch, streaming=True,
+                                family_memo=family_memo, precomputed=True,
+                            )
+                    scanned_rows += batch.num_rows
+                    scanned_batches += 1
+                if stage_sp:
+                    stage_sp.set(items=scanned_batches)
+        return scanned_rows, scanned_batches, device_error

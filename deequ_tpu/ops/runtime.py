@@ -177,6 +177,66 @@ def placement_mode() -> str:
 PLACEMENT_CACHE_TTL_S = 7 * 24 * 3600
 
 
+# ---------------------------------------------------------------------------
+# Stream pipeline knobs (ops/pipeline.py — the staged streaming executor)
+# ---------------------------------------------------------------------------
+
+DEFAULT_PIPELINE_DEPTH = 2
+
+
+def pipeline_enabled() -> bool:
+    """Whether streaming scans run the backpressured stage pipeline
+    (ops/pipeline.py): per-batch prep work — input builds, wire packing
+    with its H2D put, family kernels — moves onto a dedicated stage
+    thread that runs ahead of the consumer's ordered fold, so batch
+    N+1's transfer/host work overlaps batch N's compute.
+
+    `DEEQU_TPU_PIPELINE=0` (or `off`) forces the serial path, which is
+    bit-identical: the pipeline changes WHERE per-batch work runs, never
+    what is computed or the fold order."""
+    import os
+
+    return os.environ.get("DEEQU_TPU_PIPELINE", "") not in ("0", "off")
+
+
+def pipeline_depth() -> int:
+    """Bounded inter-stage queue depth (`DEEQU_TPU_PIPELINE_DEPTH`,
+    default 2): at most this many prepped batches — packed wire buffers
+    already put to the device — wait between the prep and fold stages.
+    Depth 1 is classic double-buffering; deeper queues smooth decode
+    jitter at the cost of one resident batch each. Host memory stays
+    O(depth + constant) batches."""
+    import os
+
+    raw = os.environ.get("DEEQU_TPU_PIPELINE_DEPTH", "")
+    try:
+        depth = int(raw)
+    except ValueError:
+        return DEFAULT_PIPELINE_DEPTH
+    return max(1, min(depth, 64))
+
+
+def source_stall_s() -> float:
+    """Per-row-group source stall in seconds (`DEEQU_TPU_SOURCE_STALL_MS`,
+    default 0 = off): a latency-injection knob for benchmarking the
+    pipeline against sources with real per-read wait — object-store GETs,
+    network filesystems — on boxes whose local disk is too fast (and
+    whose kernel readahead too good) for decode/IO overlap to matter.
+    The stall is paid by whichever thread runs the decode: the caller
+    under `DEEQU_TPU_PIPELINE=0`, the decode stage thread when pipelined
+    — so an A/B with the knob set measures exactly how much source wait
+    the pipeline hides. Never set it for real-throughput numbers."""
+    import os
+
+    raw = os.environ.get("DEEQU_TPU_SOURCE_STALL_MS", "")
+    if not raw:
+        return 0.0
+    try:
+        return max(0.0, float(raw)) / 1000.0
+    except ValueError:
+        return 0.0
+
+
 def _platform_key() -> Optional[str]:
     """Identity of the attached LINK — the cache key. Bandwidth is a
     property of how THIS HOST reaches the device, not of the device kind

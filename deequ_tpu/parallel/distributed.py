@@ -17,6 +17,7 @@ only state pytrees (bytes to KB) ever cross host boundaries, never rows.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -28,16 +29,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deequ_tpu import observe
 from deequ_tpu.analyzers.base import ScanShareableAnalyzer
 from deequ_tpu.data.table import Table
-from deequ_tpu.ops import runtime
+from deequ_tpu.ops import pipeline, runtime
 from deequ_tpu.ops.fused import (
     AnalyzerRunResult,
     HostInputs,
     PipelinedAggFold,
     _pad_size,
+    _precompute_family_kernels,
     fold_host_batch,
     materialize_host_results,
     plan_scan_members,
     prune_table_columns,
+    resolve_shift,
 )
 
 DATA_AXIS = "data"
@@ -217,92 +220,94 @@ class DistributedScanPass:
                 merge_analyzers, assisted, n_dev=n_devices, sticky=sticky
             )
 
-            device_error: Any = None
-            for batch in table.batches(global_batch):
-                # per-key builds with error capture — same isolation
-                # contract as FusedScanPass._run_pass; host-only keys
-                # build lazily (fused.HostInputs)
-                device_live = fn is not None and device_error is None
-                host_live = any(
-                    i not in host_errors for i, _m in host_members + host_assisted
-                )
-                if not device_live and not host_live:
-                    break  # everything already failed; stop scanning
-                built = HostInputs(specs, batch)
-                build_errors = built.build_errors
-                if device_live:
-                    for key in sorted(device_keys):
-                        built.materialize(key)
-                if fn is not None and device_error is None:
-                    try:
-                        with observe.span(
-                            "dispatch",
-                            cat="dispatch",
-                            rows=batch.num_rows,
-                            devices=int(n_devices),
-                        ) as dispatch_sp:
-                            for key in device_keys:
-                                if key in build_errors:
-                                    raise build_errors[key]
-                            # pad to a multiple of n_devices (pow2 per shard)
-                            per_dev = _pad_size(
-                                -(-batch.num_rows // n_devices),
-                                self.batch_size_per_device,
-                            )
-                            padded = per_dev * n_devices
-                            inputs: Dict[str, Any] = {}
-                            for key in device_keys:
-                                arr = runtime.pad_to(built[key], padded)
-                                if np.issubdtype(arr.dtype, np.integer):
-                                    arr = runtime.narrow_int_wire(
-                                        arr, key, sticky
-                                    )
-                                elif arr.dtype != np.bool_:
-                                    if (
-                                        np.dtype(dtype) == np.float32
-                                        and key.startswith("num:")
-                                    ):
-                                        # same f32 pre-centering as
-                                        # pack_batch_inputs (see fused.py)
-                                        from deequ_tpu.ops.fused import (
-                                            resolve_shift,
-                                        )
+            all_host = list(host_members) + list(host_assisted)
 
-                                        shift = resolve_shift(
-                                            key, arr, sticky, built.get
-                                        )
-                                        if shift != 0.0:
-                                            arr = (
-                                                np.asarray(
-                                                    arr, dtype=np.float64
-                                                )
-                                                - shift
-                                            )
-                                    arr = arr.astype(dtype)
-                                inputs[key] = jax.device_put(
-                                    arr, in_sharding[key]
-                                )
-                            if dispatch_sp:
-                                dispatch_sp.set(
-                                    wire_bytes=sum(
-                                        int(getattr(v, "nbytes", 0))
-                                        for v in inputs.values()
-                                    )
-                                )
-                            runtime.record_launch()
-                            fold.submit(fn(inputs))
-                    except Exception as e:  # noqa: BLE001
-                        device_error = e
-                with observe.span(
-                    "host_fold", cat="host", rows=batch.num_rows
-                ):
-                    fold_host_batch(
-                        built, build_errors, host_members, host_assisted,
-                        host_member_keys, host_aggs, host_assisted_states,
-                        host_errors,
-                        batch=batch, streaming=streaming,
-                        family_memo=family_memo,
+            def _shard_inputs(batch, built) -> Dict[str, Any]:
+                """Pad/narrow/shift each device key exactly like the
+                single-chip wire, then place it with the mesh sharding —
+                the H2D put the pipeline overlaps with compute."""
+                for key in device_keys:
+                    if key in built.build_errors:
+                        raise built.build_errors[key]
+                # pad to a multiple of n_devices (pow2 per shard)
+                per_dev = _pad_size(
+                    -(-batch.num_rows // n_devices),
+                    self.batch_size_per_device,
+                )
+                padded = per_dev * n_devices
+                inputs: Dict[str, Any] = {}
+                for key in device_keys:
+                    arr = runtime.pad_to(built[key], padded)
+                    if np.issubdtype(arr.dtype, np.integer):
+                        arr = runtime.narrow_int_wire(arr, key, sticky)
+                    elif arr.dtype != np.bool_:
+                        if (
+                            np.dtype(dtype) == np.float32
+                            and key.startswith("num:")
+                        ):
+                            # same f32 pre-centering as
+                            # pack_batch_inputs (see fused.py)
+                            shift = resolve_shift(key, arr, sticky, built.get)
+                            if shift != 0.0:
+                                arr = np.asarray(arr, dtype=np.float64) - shift
+                        arr = arr.astype(dtype)
+                    inputs[key] = jax.device_put(arr, in_sharding[key])
+                return inputs
+
+            device_error: Any = None
+            if streaming and runtime.pipeline_enabled():
+                device_error = self._scan_pipelined(
+                    table, global_batch, fn, specs, device_keys, n_devices,
+                    _shard_inputs, fold, all_host, host_members,
+                    host_assisted, host_member_keys, host_aggs,
+                    host_assisted_states, host_errors, family_memo,
+                )
+            else:
+                for batch in table.batches(global_batch):
+                    # per-key builds with error capture — same isolation
+                    # contract as FusedScanPass._run_pass; host-only keys
+                    # build lazily (fused.HostInputs)
+                    device_live = fn is not None and device_error is None
+                    host_live = any(
+                        i not in host_errors for i, _m in all_host
                     )
+                    if not device_live and not host_live:
+                        break  # everything already failed; stop scanning
+                    built = HostInputs(specs, batch)
+                    build_errors = built.build_errors
+                    if device_live:
+                        for key in sorted(device_keys):
+                            built.materialize(key)
+                    if fn is not None and device_error is None:
+                        try:
+                            with observe.span(
+                                "dispatch",
+                                cat="dispatch",
+                                rows=batch.num_rows,
+                                devices=int(n_devices),
+                            ) as dispatch_sp:
+                                inputs = _shard_inputs(batch, built)
+                                if dispatch_sp:
+                                    dispatch_sp.set(
+                                        wire_bytes=sum(
+                                            int(getattr(v, "nbytes", 0))
+                                            for v in inputs.values()
+                                        )
+                                    )
+                                runtime.record_launch()
+                                fold.submit(fn(inputs))
+                        except Exception as e:  # noqa: BLE001
+                            device_error = e
+                    with observe.span(
+                        "host_fold", cat="host", rows=batch.num_rows
+                    ):
+                        fold_host_batch(
+                            built, build_errors, host_members, host_assisted,
+                            host_member_keys, host_aggs, host_assisted_states,
+                            host_errors,
+                            batch=batch, streaming=streaming,
+                            family_memo=family_memo,
+                        )
             aggs, assisted_states = [], []
             if device_error is None:
                 try:
@@ -343,6 +348,110 @@ class DistributedScanPass:
                 results.setdefault(i, AnalyzerRunResult(self.analyzers[i], error=e))
 
         return [results[i] for i in range(len(self.analyzers))]
+
+    def _scan_pipelined(
+        self,
+        table,
+        global_batch,
+        fn,
+        specs,
+        device_keys,
+        n_devices,
+        shard_inputs,
+        fold,
+        all_host,
+        host_members,
+        host_assisted,
+        host_member_keys,
+        host_aggs,
+        host_assisted_states,
+        host_errors,
+        family_memo,
+    ):
+        """Sharded-stream twin of `FusedScanPass._scan_pipelined`: the
+        per-batch prep — eager builds, pad/narrow/shift, the sharded
+        `jax.device_put` — runs on a stage thread so batch N+1's H2D
+        lands on the mesh while batch N's collectives run; every fold
+        stays on this thread in batch order (bit-identical to serial)."""
+        device_down = threading.Event()
+
+        def _prep(batch):
+            built = HostInputs(specs, batch)
+            inputs = device_exc = None
+            if fn is not None and not device_down.is_set():
+                for key in sorted(device_keys):
+                    built.materialize(key)
+                try:
+                    with observe.span(
+                        "dispatch",
+                        cat="dispatch",
+                        rows=batch.num_rows,
+                        devices=int(n_devices),
+                    ) as dispatch_sp:
+                        inputs = shard_inputs(batch, built)
+                        if dispatch_sp:
+                            dispatch_sp.set(
+                                wire_bytes=sum(
+                                    int(getattr(v, "nbytes", 0))
+                                    for v in inputs.values()
+                                )
+                            )
+                except Exception as e:  # noqa: BLE001
+                    device_exc = e
+                    inputs = None
+                    device_down.set()
+            if any(i not in host_errors for i, _m in all_host):
+                with observe.span(
+                    "host_prep", cat="host", rows=batch.num_rows
+                ):
+                    _precompute_family_kernels(
+                        built, host_assisted, batch,
+                        host_members=host_members, host_errors=host_errors,
+                        streaming=True, family_memo=family_memo,
+                    )
+            return batch, built, inputs, device_exc
+
+        device_error: Any = None
+        items = pipeline.staged(table.batches(global_batch), _prep, name="prep")
+        with contextlib.closing(items):
+            with observe.span(
+                "pipe_stage", cat="pipeline", stage="fold"
+            ) as stage_sp:
+                n_items = 0
+                for batch, built, inputs, device_exc in items:
+                    device_live = fn is not None and device_error is None
+                    host_live = any(i not in host_errors for i, _m in all_host)
+                    if not device_live and not host_live:
+                        break  # everything already failed; stop scanning
+                    with observe.span(
+                        "pipe_item", cat="pipeline", stage="fold",
+                        rows=batch.num_rows,
+                    ):
+                        if device_live:
+                            if device_exc is not None:
+                                device_error = device_exc
+                            elif inputs is not None:
+                                try:
+                                    runtime.record_launch()
+                                    fold.submit(fn(inputs))
+                                except Exception as e:  # noqa: BLE001
+                                    device_error = e
+                            if device_error is not None:
+                                device_down.set()
+                        with observe.span(
+                            "host_fold", cat="host", rows=batch.num_rows
+                        ):
+                            fold_host_batch(
+                                built, built.build_errors, host_members,
+                                host_assisted, host_member_keys, host_aggs,
+                                host_assisted_states, host_errors,
+                                batch=batch, streaming=True,
+                                family_memo=family_memo, precomputed=True,
+                            )
+                    n_items += 1
+                if stage_sp:
+                    stage_sp.set(items=n_items)
+        return device_error
 
 
 _BINCOUNT_CACHE: Dict[Any, Any] = {}

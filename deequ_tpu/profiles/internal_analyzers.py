@@ -43,7 +43,7 @@ from deequ_tpu.analyzers.base import (
     Preconditions,
     ScanShareableAnalyzer,
 )
-from deequ_tpu.analyzers.sketch import ApproxQuantileState, _next_batch_seed
+from deequ_tpu.analyzers.sketch import ApproxQuantileState, _batch_seed
 from deequ_tpu.analyzers.states import State
 from deequ_tpu.core.maybe import Success
 from deequ_tpu.core.metrics import Entity, Metric
@@ -476,15 +476,19 @@ class _OptimisticNumericStats(ScanShareableAnalyzer):
                 0.0, 0.0, float("inf"), float("-inf"), 0.0, None, True
             )
         else:
-            digest = KLLSketch(
-                k=k_for_error(self.relative_error), seed=_next_batch_seed()
-            )
             n = int(out["n"])
+            level = int(out["level"]) if n > 0 else 0
             if n > 0:
-                level = int(out["level"])
                 stride = 1 << level
                 kept = max(0, -(-(n - stride // 2) // stride))
                 sample = np.asarray(out["sample"], dtype=np.float64)[:kept]
+            else:
+                sample = np.empty(0, dtype=np.float64)
+            digest = KLLSketch(
+                k=k_for_error(self.relative_error),
+                seed=_batch_seed(sample, n, level),
+            )
+            if n > 0:
                 digest.insert_level(sample, level, true_count=n)
             partial = OptimisticNumericState(
                 float(out["count"]),

@@ -15,8 +15,6 @@
 
 from __future__ import annotations
 
-import itertools
-
 import numpy as np
 import pytest
 
@@ -178,12 +176,6 @@ def _run_family_analysis(table):
     return out
 
 
-def _pin_sketch_seeds(monkeypatch):
-    from deequ_tpu.analyzers import sketch as sketch_mod
-
-    monkeypatch.setattr(sketch_mod, "_BATCH_SEED_COUNTER", itertools.count(1))
-
-
 @pytest.fixture
 def host_placed(monkeypatch):
     """Force host placement: the family kernels only run for HOST-folded
@@ -194,10 +186,8 @@ def host_placed(monkeypatch):
 @needs_native
 class TestGroupedDispatchParity:
     def test_end_to_end_equal_under_toggle(self, monkeypatch, host_placed):
-        _pin_sketch_seeds(monkeypatch)
         batched = _run_family_analysis(_family_table())
         monkeypatch.setenv("DEEQU_TPU_NO_MULTI_FAMILY", "1")
-        _pin_sketch_seeds(monkeypatch)
         solo = _run_family_analysis(_family_table())
         assert batched.keys() == solo.keys()
         for key in batched:
@@ -252,10 +242,8 @@ class TestGroupedDispatchParity:
         def stream():
             return Table.scan_parquet(path, batch_rows=100_000)
 
-        _pin_sketch_seeds(monkeypatch)
         batched = _run_family_analysis(stream())
         monkeypatch.setenv("DEEQU_TPU_NO_MULTI_FAMILY", "1")
-        _pin_sketch_seeds(monkeypatch)
         solo = _run_family_analysis(stream())
         assert batched.keys() == solo.keys()
         for key in batched:

@@ -10,8 +10,6 @@ analyzer outputs against the native run.
 
 from __future__ import annotations
 
-import itertools
-
 import numpy as np
 import pytest
 
@@ -37,7 +35,6 @@ def test_profile_identical_without_native(no_native, monkeypatch):
     # order matters: the FALLBACK profile runs first under the fixture's
     # no-native pins, then the pins are overwritten (not restored) so
     # the reference profile runs with the real C kernels
-    from deequ_tpu.analyzers import sketch as sketch_mod
     from deequ_tpu.data.table import Table
     from deequ_tpu.profiles.column_profiler import ColumnProfiler
 
@@ -59,18 +56,12 @@ def test_profile_identical_without_native(no_native, monkeypatch):
             }
         )
 
-    monkeypatch.setattr(
-        sketch_mod, "_BATCH_SEED_COUNTER", itertools.count(1)
-    )
     fallback = ColumnProfiler.profile(build()).profiles
 
     # undo the fixture's pins for the reference run
     monkeypatch.setattr(native, "_TRIED", False)
     monkeypatch.setattr(native, "_LIB", None)
     assert native.available()
-    monkeypatch.setattr(
-        sketch_mod, "_BATCH_SEED_COUNTER", itertools.count(1)
-    )
     with_native = ColumnProfiler.profile(build()).profiles
 
     assert fallback.keys() == with_native.keys()

@@ -149,8 +149,9 @@ class TestHist16RadixSelect:
             calls["hist16"] += 1
             return real_hist16(bins, interpret=True)
 
-        def run(seed, use_hist):
-            sketch_mod._BATCH_SEED_COUNTER = __import__("itertools").count(seed)
+        def run(use_hist):
+            # KLL seeds are content-derived (sketch._batch_seed): equal
+            # samples give equal sketches with no counter pinning
             if use_hist:
                 monkeypatch.setattr(
                     sketch_mod, "_hist16_available", lambda n: True
@@ -165,7 +166,7 @@ class TestHist16RadixSelect:
             state = res[0].state_or_raise()
             return res[0].analyzer.compute_metric_from(state).value.get()
 
-        via_hist = run(1000, True)
+        via_hist = run(True)
         assert calls["hist16"] >= 1  # the kernel actually ran
-        via_sort = run(1000, False)
+        via_sort = run(False)
         assert via_hist == via_sort, (via_hist, via_sort)

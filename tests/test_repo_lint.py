@@ -179,6 +179,57 @@ def test_lint_main_is_invocable_as_script():
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+# -- PIPELINE: host syncs in stage-worker files (ISSUE 5 satellite) ----------
+
+
+def test_pipeline_checker_flags_sync_anywhere():
+    """Unlike HOTLOOP, the PIPELINE rule bans syncs even OUTSIDE loops:
+    all of a stage-worker file runs on (or schedules onto) stage
+    threads, where one sync serializes the overlap."""
+    lint = _lint_module()
+    path = _tmp_source(
+        "import jax\n"
+        "def prep(batch):\n"
+        "    return jax.device_get(batch)\n"
+        "def wait(x):\n"
+        "    x.block_until_ready()\n"
+    )
+    try:
+        findings = lint.check_pipeline_syncs(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 2
+    assert all("PIPELINE" in f for f in findings)
+    assert any("device_get" in f for f in findings)
+    assert any("block_until_ready" in f for f in findings)
+
+
+def test_pipeline_checker_allows_async_stage_code():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import queue\n"
+        "def worker(q, fn, items):\n"
+        "    for item in items:\n"
+        "        q.put(fn(item))\n"
+    )
+    try:
+        findings = lint.check_pipeline_syncs(path)
+    finally:
+        os.unlink(path)
+    assert findings == []
+
+
+def test_pipeline_rule_covers_stage_worker_files():
+    """The rule is wired to the actual stage-worker files, and those
+    files exist — a rename must update the lint scope with it."""
+    lint = _lint_module()
+    rels = set(lint.PIPELINE_FILES)
+    assert os.path.join("deequ_tpu", "ops", "pipeline.py") in rels
+    assert os.path.join("deequ_tpu", "data", "source.py") in rels
+    for rel in rels:
+        assert os.path.exists(os.path.join(REPO, rel)), rel
+
+
 # -- GLOBALMUT: unguarded module-global mutation (ISSUE 4 satellite) ---------
 
 

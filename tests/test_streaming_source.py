@@ -345,3 +345,43 @@ class TestStreamingParity:
             for k, v in ctx.metric_map[Histogram("c")].value.get().values.items()
         }
         assert hist == dict(collections.Counter(table.column("c").to_pylist()))
+
+    def test_source_stall_knob_is_inert_on_results(self, tmp_path, monkeypatch):
+        """DEEQU_TPU_SOURCE_STALL_MS (the object-store latency model used
+        by bench.py's pipeline A/B) delays the decoding thread but must
+        never change what the stream yields — same batches, same metrics
+        — and malformed values fall back to off."""
+        from deequ_tpu.ops import runtime
+
+        monkeypatch.setenv("DEEQU_TPU_SOURCE_STALL_MS", "garbage")
+        assert runtime.source_stall_s() == 0.0
+        monkeypatch.setenv("DEEQU_TPU_SOURCE_STALL_MS", "-5")
+        assert runtime.source_stall_s() == 0.0
+        monkeypatch.setenv("DEEQU_TPU_SOURCE_STALL_MS", "2.5")
+        assert runtime.source_stall_s() == 0.0025
+
+        rng = np.random.default_rng(3)
+        n = 30_000
+        table = pa.table(
+            {
+                "x": rng.normal(0, 1, n),
+                "c": np.array(["p", "q"], dtype=object)[rng.integers(0, 2, n)],
+            }
+        )
+        path = str(tmp_path / "stalled.parquet")
+        pq.write_table(table, path, row_group_size=10_000)
+
+        def metrics():
+            ctx = (
+                AnalysisRunner.on_data(Table.scan_parquet(path))
+                .add_analyzers([Size(), Mean("x")])
+                .run()
+            )
+            return (
+                ctx.metric_map[Size()].value.get(),
+                ctx.metric_map[Mean("x")].value.get(),
+            )
+
+        stalled = metrics()  # 3 row groups x 2.5ms, exercises the sleep
+        monkeypatch.delenv("DEEQU_TPU_SOURCE_STALL_MS")
+        assert metrics() == stalled

@@ -74,11 +74,131 @@ def random_table(rng: np.random.Generator) -> Table:
     )
 
 
-def random_check(rng: np.random.Generator) -> Check:
-    """3-9 random DSL constraints. Exact-metric constraints use
+def wide_table(rng: np.random.Generator) -> Table:
+    """50-column layout (the BENCH_STREAM_1B_WIDE shape, shrunk): 20
+    doubles, 15 longs at mixed cardinalities, 10 dictionary-encoded
+    strings, 5 low-cardinality floats — so the counts fast paths,
+    dictionary memos, int narrowing and the stream pipeline's packing
+    all interact across many columns at once."""
+    n = int(rng.integers(500, 2500))
+    null_density = float(rng.choice([0.0, 0.05, 0.3]))
+    cols: dict = {}
+    types: dict = {}
+    for i in range(20):
+        v = rng.normal(rng.uniform(-50, 50), rng.uniform(0.1, 10.0), n)
+        v[rng.random(n) < null_density] = np.nan
+        cols[f"d{i:02d}"] = list(v)
+        types[f"d{i:02d}"] = ColumnType.DOUBLE
+    for i in range(15):
+        card = int(rng.choice([2, 100, 10_000]))
+        cols[f"l{i:02d}"] = [int(v) for v in rng.integers(0, card, n)]
+        types[f"l{i:02d}"] = ColumnType.LONG
+    for i in range(10):
+        card = int(rng.choice([1, 3, 50]))
+        pool = np.array(
+            [f"s{i}_{j}" for j in range(card)] + ["v1"], dtype=object
+        )
+        sv = pool[rng.integers(0, len(pool), n)]
+        sv[rng.random(n) < null_density] = None
+        cols[f"s{i:02d}"] = list(sv)
+        types[f"s{i:02d}"] = ColumnType.STRING
+    for i in range(5):
+        v = rng.integers(-2, 11, n) / 100.0
+        v[rng.random(n) < null_density] = np.nan
+        cols[f"r{i}"] = list(v)
+        types[f"r{i}"] = ColumnType.DOUBLE
+    return Table.from_pydict(cols, types=types)
+
+
+def lineitem_table(rng: np.random.Generator) -> Table:
+    """TPC-H lineitem-like layout: quantities, prices, the canonical
+    low-cardinality .00-.10 discount/tax floats (the hash-count family
+    fast path), tiny-alphabet flag strings, a high-cardinality comment
+    column (dictionary memos under pressure), and skewed join keys."""
+    n = int(rng.integers(500, 3000))
+    qty = rng.integers(1, 51, n).astype(np.float64)
+    price = np.round(qty * rng.uniform(900.0, 1100.0, n), 2)
+    null_density = float(rng.choice([0.0, 0.02]))
+    price[rng.random(n) < null_density] = np.nan
+    flags = np.array(["A", "N", "R"], dtype=object)
+    status = np.array(["O", "F"], dtype=object)
+    modes = np.array(
+        ["AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB", "REG AIR"],
+        dtype=object,
+    )
+    comments = np.array(
+        [f"comment {i} about v1" for i in range(max(16, n // 3))],
+        dtype=object,
+    )
+    return Table.from_pydict(
+        {
+            "l_orderkey": [int(v) for v in rng.integers(0, max(1, n // 4), n)],
+            "l_suppkey": [int(v) for v in rng.integers(0, 100, n)],
+            "l_quantity": list(qty),
+            "l_extendedprice": list(price),
+            "l_discount": list(rng.integers(0, 11, n) / 100.0),
+            "l_tax": list(rng.integers(0, 9, n) / 100.0),
+            "l_returnflag": list(flags[rng.integers(0, 3, n)]),
+            "l_linestatus": list(status[rng.integers(0, 2, n)]),
+            "l_shipmode": list(modes[rng.integers(0, 7, n)]),
+            "l_comment": list(comments[rng.integers(0, len(comments), n)]),
+        },
+        types={
+            "l_orderkey": ColumnType.LONG,
+            "l_suppkey": ColumnType.LONG,
+            "l_quantity": ColumnType.DOUBLE,
+            "l_extendedprice": ColumnType.DOUBLE,
+            "l_discount": ColumnType.DOUBLE,
+            "l_tax": ColumnType.DOUBLE,
+            "l_returnflag": ColumnType.STRING,
+            "l_linestatus": ColumnType.STRING,
+            "l_shipmode": ColumnType.STRING,
+            "l_comment": ColumnType.STRING,
+        },
+    )
+
+
+LAYOUTS = {
+    "narrow": random_table,
+    "wide": wide_table,
+    "lineitem": lineitem_table,
+}
+
+
+def layout_roles(layout: str, rng: np.random.Generator) -> tuple:
+    """Map a layout's columns onto `random_check`'s five roles
+    (num1, num2, string, int, lowcard_float)."""
+    if layout == "narrow":
+        return ("x", "y", "s", "g", "r")
+    if layout == "wide":
+        return (
+            f"d{int(rng.integers(0, 20)):02d}",
+            f"d{int(rng.integers(0, 20)):02d}",
+            f"s{int(rng.integers(0, 10)):02d}",
+            f"l{int(rng.integers(0, 15)):02d}",
+            f"r{int(rng.integers(0, 5))}",
+        )
+    return (
+        "l_extendedprice",
+        str(rng.choice(["l_quantity", "l_tax"])),
+        str(rng.choice(["l_returnflag", "l_shipmode", "l_comment"])),
+        str(rng.choice(["l_suppkey", "l_orderkey"])),
+        "l_discount",
+    )
+
+
+def random_check(
+    rng: np.random.Generator,
+    cols: tuple = ("x", "y", "s", "g", "r"),
+) -> Check:
+    """3-9 random DSL constraints over role-mapped columns
+    `(num1, num2, string, int, lowcard_float)` — ("x","y","s","g","r")
+    in the canonical narrow layout; the wide/lineitem layouts map their
+    own columns onto the same roles. Exact-metric constraints use
     thresholds drawn continuously (probability ~0 of landing within
     engine FP jitter of the metric); sketch-backed constraints use
     far-out bounds so rank-error randomization cannot flip them."""
+    x, y, s, g, r = cols
     size_t = float(rng.uniform(0, 3000))
     frac_t = float(rng.uniform(0, 1))
     stat_t = float(rng.uniform(-120, 120))
@@ -86,70 +206,70 @@ def random_check(rng: np.random.Generator) -> Check:
 
     builders = [
         lambda c: c.has_size(lambda v, t=size_t: v >= t),
-        lambda c: c.has_size(lambda v, t=size_t: v >= t).where("g > 1"),
-        lambda c: c.is_complete("x"),
-        lambda c: c.is_complete("s"),
-        lambda c: c.has_completeness("x", lambda v, t=frac_t: v >= t),
+        lambda c: c.has_size(lambda v, t=size_t: v >= t).where(f"{g} > 1"),
+        lambda c: c.is_complete(x),
+        lambda c: c.is_complete(s),
+        lambda c: c.has_completeness(x, lambda v, t=frac_t: v >= t),
         lambda c: c.has_completeness(
-            "s", lambda v, t=frac_t: v >= t
-        ).where("g >= 0"),
-        lambda c: c.is_unique("g"),
-        lambda c: c.has_uniqueness(("g",), lambda v, t=frac_t: v >= t),
-        lambda c: c.has_distinctness(("s",), lambda v, t=frac_t: v >= t),
+            s, lambda v, t=frac_t: v >= t
+        ).where(f"{g} >= 0"),
+        lambda c: c.is_unique(g),
+        lambda c: c.has_uniqueness((g,), lambda v, t=frac_t: v >= t),
+        lambda c: c.has_distinctness((s,), lambda v, t=frac_t: v >= t),
         lambda c: c.has_unique_value_ratio(
-            ("g",), lambda v, t=frac_t: v >= t
+            (g,), lambda v, t=frac_t: v >= t
         ),
         lambda c: c.has_number_of_distinct_values(
-            "g", lambda v, t=size_t: v <= max(t, 1)
+            g, lambda v, t=size_t: v <= max(t, 1)
         ),
-        lambda c: c.has_entropy("g", lambda v, t=frac_t: v >= t),
+        lambda c: c.has_entropy(g, lambda v, t=frac_t: v >= t),
         lambda c: c.has_mutual_information(
-            "s", "g", lambda v, t=frac_t: v >= t * 0.1
+            s, g, lambda v, t=frac_t: v >= t * 0.1
         ),
-        lambda c: c.has_min("x", lambda v, t=stat_t: v <= t),
-        lambda c: c.has_max("x", lambda v, t=stat_t: v >= t),
-        lambda c: c.has_mean("x", lambda v, t=stat_t: v >= t),
+        lambda c: c.has_min(x, lambda v, t=stat_t: v <= t),
+        lambda c: c.has_max(x, lambda v, t=stat_t: v >= t),
+        lambda c: c.has_mean(x, lambda v, t=stat_t: v >= t),
         # low-card float column: the hash-count family path
-        lambda c: c.has_mean("r", lambda v, t=frac_t: v >= t * 0.1),
-        lambda c: c.has_min("r", lambda v: v >= -0.02),
+        lambda c: c.has_mean(r, lambda v, t=frac_t: v >= t * 0.1),
+        lambda c: c.has_min(r, lambda v: v >= -0.02),
         lambda c: c.has_standard_deviation(
-            "r", lambda v, t=frac_t: v <= max(t, 0.2)
+            r, lambda v, t=frac_t: v <= max(t, 0.2)
         ),
         lambda c: c.has_approx_quantile(
-            "r", 0.5, lambda v, t=far: (v >= t) if t < 0 else (v <= t)
+            r, 0.5, lambda v, t=far: (v >= t) if t < 0 else (v <= t)
         ),
         lambda c: c.has_approx_count_distinct(
-            "r", lambda v, t=far: (v >= t) if t < 0 else (v <= t)
+            r, lambda v, t=far: (v >= t) if t < 0 else (v <= t)
         ),
-        lambda c: c.has_sum("x", lambda v, t=stat_t: v >= t),
-        lambda c: c.has_standard_deviation("x", lambda v, t=frac_t: v >= t),
+        lambda c: c.has_sum(x, lambda v, t=stat_t: v >= t),
+        lambda c: c.has_standard_deviation(x, lambda v, t=frac_t: v >= t),
         lambda c: c.has_correlation(
-            "x", "y", lambda v, t=frac_t: abs(v) >= t * 0.5
+            x, y, lambda v, t=frac_t: abs(v) >= t * 0.5
         ),
         # sketch-backed: far-out bounds, immune to rank-error jitter
         lambda c: c.has_approx_quantile(
-            "x", 0.5, lambda v, t=far: (v >= t) if t < 0 else (v <= t)
+            x, 0.5, lambda v, t=far: (v >= t) if t < 0 else (v <= t)
         ),
         lambda c: c.has_approx_count_distinct(
-            "g", lambda v, t=far: (v >= t) if t < 0 else (v <= t)
+            g, lambda v, t=far: (v >= t) if t < 0 else (v <= t)
         ),
-        lambda c: c.satisfies("x > 0", "pos", lambda v, t=frac_t: v >= t),
+        lambda c: c.satisfies(f"{x} > 0", "pos", lambda v, t=frac_t: v >= t),
         lambda c: c.has_pattern(
-            "s", r"^v\d+$", lambda v, t=frac_t: v >= t
+            s, r"^v\d+$", lambda v, t=frac_t: v >= t
         ),
-        lambda c: c.contains_email("s", lambda v, t=frac_t: v <= max(t, 0.5)),
+        lambda c: c.contains_email(s, lambda v, t=frac_t: v <= max(t, 0.5)),
         lambda c: c.has_data_type(
-            "s",
+            s,
             ConstrainableDataTypes.INTEGRAL,
             lambda v, t=frac_t: v <= max(t, 0.5),
         ),
-        lambda c: c.is_non_negative("x"),
-        lambda c: c.is_positive("x").where("g >= 1"),
-        lambda c: c.is_less_than("x", "y"),
-        lambda c: c.is_greater_than_or_equal_to("y", "x"),
-        lambda c: c.is_contained_in("s", ["x", "-3", "7.5", "v1"]),
+        lambda c: c.is_non_negative(x),
+        lambda c: c.is_positive(x).where(f"{g} >= 1"),
+        lambda c: c.is_less_than(x, y),
+        lambda c: c.is_greater_than_or_equal_to(y, x),
+        lambda c: c.is_contained_in(s, ["x", "-3", "7.5", "v1"]),
         lambda c: c.is_contained_in(
-            "g", lower_bound=0.0, upper_bound=1000.0
+            g, lower_bound=0.0, upper_bound=1000.0
         ),
     ]
     level = CheckLevel.ERROR if rng.random() < 0.5 else CheckLevel.WARNING
@@ -284,3 +404,113 @@ def test_suite_agrees_streamed_vs_in_memory(seed, monkeypatch, tmp_path):
         TableCls.scan_parquet(path, batch_rows=max(64, len(table.column("x")) // 5))
     )
     assert_snapshots_agree(in_memory, streamed, "memory-vs-stream")
+
+
+# -- layout fuzz + the pipeline on/off differential (ISSUE 5) ----------------
+
+
+def _count_spans(roots, name: str) -> int:
+    total = 0
+    stack = list(roots)
+    while stack:
+        sp = stack.pop()
+        if sp.name == name:
+            total += 1
+        stack.extend(sp.children)
+    return total
+
+
+@pytest.mark.parametrize(
+    "layout,seed",
+    [(layout, seed) for layout in ("narrow", "wide", "lineitem") for seed in range(4)],
+)
+def test_pipeline_on_off_bit_identical(layout, seed, monkeypatch, tmp_path):
+    """The DEEQU_TPU_PIPELINE=0 serial fallback must be BIT-identical to
+    the pipelined streaming path — exact snapshot equality, sketches
+    included (same engine, same fold order, same inputs: nothing may
+    diverge). Runs every layout so wide packing, dictionary memos and
+    the lineitem fast paths all cross the stage boundary. Also pins
+    tracing-inertness: running under a tracer must not change one bit
+    of the result, and the trace must show the pipeline actually
+    engaged (pipe_stage spans for every stage)."""
+    from deequ_tpu import observe
+    from deequ_tpu.data.table import Table as TableCls
+
+    rng = np.random.default_rng(11_000 + seed)
+    table = LAYOUTS[layout](rng)
+    n = table.num_rows
+    roles = layout_roles(layout, rng)
+    checks = [random_check(rng, roles) for _ in range(int(rng.integers(1, 3)))]
+    # alternate placements so both the H2D prep path (device) and the
+    # family-kernel host path cross the pipeline's stage boundary
+    placement = "device" if seed % 2 else "host"
+
+    path = str(tmp_path / "fuzz.parquet")
+    table.to_parquet(
+        path, row_group_size=max(64, n // 7), dictionary_encode_strings=True
+    )
+
+    def run(pipeline_env):
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+        monkeypatch.setenv("DEEQU_TPU_PIPELINE", pipeline_env)
+        data = TableCls.scan_parquet(path, batch_rows=max(64, n // 5))
+        builder = VerificationSuite().on_data(data)
+        for check in checks:
+            builder = builder.add_check(check)
+        return suite_snapshot(builder.with_engine("single").run())
+
+    serial = run("0")
+    pipelined = run("1")
+    assert serial == pipelined, (layout, seed, placement)
+
+    with observe.tracing() as tracer:
+        traced = run("1")
+    assert traced == pipelined, ("tracing changed results", layout, seed)
+    stages = {
+        sp.attrs.get("stage")
+        for root in tracer.roots
+        for sp in _iter_spans(root)
+        if sp.name == "pipe_stage"
+    }
+    assert {"decode", "prep", "fold"} <= stages, (
+        "pipeline did not engage under tracing",
+        stages,
+    )
+
+
+def _iter_spans(root):
+    stack = [root]
+    while stack:
+        sp = stack.pop()
+        yield sp
+        stack.extend(sp.children)
+
+
+@pytest.mark.parametrize(
+    "layout,seed",
+    [("wide", 0), ("wide", 1), ("lineitem", 0), ("lineitem", 1)],
+)
+def test_suite_layouts_agree_across_engines(layout, seed, monkeypatch):
+    """Wide/lineitem layouts through the three in-memory engines — the
+    layout generalization of `test_suite_agrees_across_engines`."""
+    rng = np.random.default_rng(12_000 + seed)
+    table = LAYOUTS[layout](rng)
+    roles = layout_roles(layout, rng)
+    checks = [random_check(rng, roles) for _ in range(int(rng.integers(1, 3)))]
+
+    def run(engine, mesh=None, placement=None):
+        if placement is None:
+            monkeypatch.delenv("DEEQU_TPU_PLACEMENT", raising=False)
+        else:
+            monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+        builder = VerificationSuite().on_data(table)
+        for check in checks:
+            builder = builder.add_check(check)
+        return suite_snapshot(builder.with_engine(engine, mesh).run())
+
+    host_fold = run("single", placement="host")
+    single_dev = run("single", placement="device")
+    mesh = run("distributed", mesh=data_mesh())
+
+    assert_snapshots_agree(host_fold, single_dev, f"{layout}:host-vs-device")
+    assert_snapshots_agree(host_fold, mesh, f"{layout}:host-vs-mesh")

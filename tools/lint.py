@@ -13,6 +13,12 @@ Checks:
              deequ_tpu.observe (span()/timed_call()) so traces stay the
              single source of runtime truth and the disabled path keeps
              its measured near-zero overhead.
+  PIPELINE — no `jax.device_get(...)` / `.block_until_ready()` anywhere
+             in the stream-pipeline stage-worker files
+             (deequ_tpu/ops/pipeline.py, deequ_tpu/data/source.py): a
+             host sync on a stage thread serializes the very overlap
+             the pipeline exists to create — device syncs belong to
+             the fold stage (`PipelinedAggFold`) only.
   GLOBALMUT — module-global dicts/lists in deequ_tpu/ops/, runners/,
              and parallel/ must not be mutated inside functions without
              a lock: engine code runs on worker threads (the family
@@ -39,6 +45,14 @@ from typing import Iterator, List
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HOT_LOOP_FILES = [os.path.join("deequ_tpu", "ops", "fused.py")]
 HOT_LOOP_FORBIDDEN = {"device_get", "block_until_ready"}
+# Stage-worker files where a host sync is banned OUTRIGHT (not just in
+# loops): their code runs on pipeline stage threads, where one sync
+# serializes the decode/prep/compute overlap.
+PIPELINE_FILES = [
+    os.path.join("deequ_tpu", "ops", "pipeline.py"),
+    os.path.join("deequ_tpu", "data", "source.py"),
+]
+PIPELINE_FORBIDDEN = {"device_get", "block_until_ready"}
 # Engine dirs where ad-hoc clock reads are banned (observe/ owns timing).
 TIMING_DIRS = (
     os.path.join("deequ_tpu", "runners"),
@@ -116,6 +130,31 @@ def check_hot_loops(path: str) -> List[str]:
             self.generic_visit(node)
 
     Visitor().visit(tree)
+    return findings
+
+
+# -- PIPELINE: host syncs in stage-worker files ------------------------------
+
+
+def check_pipeline_syncs(path: str) -> List[str]:
+    """Flag `jax.device_get(...)` / `.block_until_ready()` calls anywhere
+    in a stage-worker file: stage threads must stay async — the fold
+    stage (`PipelinedAggFold` in ops/fused.py) owns every device sync."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    findings: List[str] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in PIPELINE_FORBIDDEN
+        ):
+            findings.append(
+                f"{_rel(path)}:{node.lineno}: PIPELINE "
+                f"`.{node.func.attr}` in a stage-worker file — a host "
+                f"sync on a stage thread serializes the pipeline; move "
+                f"the sync to the fold stage (PipelinedAggFold)"
+            )
     return findings
 
 
@@ -424,6 +463,11 @@ def main() -> int:
         path = os.path.join(REPO, rel)
         if os.path.exists(path):
             findings.extend(check_hot_loops(path))
+
+    for rel in PIPELINE_FILES:
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):
+            findings.extend(check_pipeline_syncs(path))
 
     for path in _python_files():
         rel = _rel(path)
