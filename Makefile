@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint typecheck analyze test test-fast trace-demo
+.PHONY: lint typecheck analyze sentinel test test-fast trace-demo
 
 lint:
 	$(PY) tools/lint.py
@@ -21,6 +21,13 @@ typecheck:
 # end-to-end without touching data)
 analyze: lint typecheck
 	JAX_PLATFORMS=cpu $(PY) tools/explain_bench.py
+
+# regression sentinel: anomaly strategies over the engine telemetry
+# series (ENGINE_METRICS.json, appended by bench runs) and the
+# committed BENCH_r0*.json history; exits nonzero when throughput or
+# phase shares regress — see BENCH.md
+sentinel:
+	JAX_PLATFORMS=cpu $(PY) tools/sentinel.py
 
 trace-demo:
 	JAX_PLATFORMS=cpu PYTHONPATH=.:examples $(PY) examples/tracing_example.py

@@ -708,8 +708,6 @@ def main() -> None:
             baseline = float(baseline_env)
             baseline_note = "override"
 
-    import resource
-
     cold = mode == "stream" and os.environ.get("BENCH_COLD", "") in (
         "1",
         "true",
@@ -836,13 +834,53 @@ def main() -> None:
             },
         }
 
-    peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    # /proc-based accounting (observe.telemetry): peak RSS and major
+    # page faults come from the process itself, not external measurement
+    from deequ_tpu.observe import telemetry
+
+    resources = telemetry.proc_resources()
+    peak_rss_mb = resources.get("peak_rss_mb", 0.0)
     if cold:
         extra.update(
             rows=n_rows,
             elapsed_s=round(best, 1),
             peak_rss_mb=round(peak_rss_mb),
+            major_faults=int(resources.get("major_faults", 0)),
         )
+    # append this run to the engine-telemetry time series so
+    # `make sentinel` can watch throughput/phase shares across rounds
+    # (BENCH.md). BENCH_ENGINE_REPO overrides the path; 0/off disables.
+    engine_repo_env = os.environ.get("BENCH_ENGINE_REPO", "")
+    if engine_repo_env.lower() not in ("0", "off", "none"):
+        engine_repo_path = engine_repo_env or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "ENGINE_METRICS.json"
+        )
+        try:
+            from deequ_tpu.repository import engine as engine_telemetry
+            from deequ_tpu.repository.fs import FileSystemMetricsRepository
+
+            engine_record = {
+                "engine.rows_per_s": rows_per_sec,
+                "engine.wall_s": best,
+                "engine.rows": float(n_rows),
+                "engine.peak_rss_mb": peak_rss_mb,
+                "engine.major_faults": resources.get("major_faults", 0.0),
+            }
+            if best_cpu is not None:
+                engine_record["engine.cpu_s"] = best_cpu
+            for phase, secs in trace_fields.get("trace_phases_s", {}).items():
+                engine_record[f"engine.phase.{phase}_s"] = secs
+            engine_telemetry.persist_engine_record(
+                FileSystemMetricsRepository(engine_repo_path),
+                engine_record,
+                engine_telemetry.engine_result_key(
+                    suite="bench", dataset=f"{mode}:{n_rows}"
+                ),
+            )
+            print(f"# bench: engine series -> {engine_repo_path}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - telemetry must never fail the bench
+            print(f"# bench: engine series persist failed: {e}", file=sys.stderr)
+
     warm_note = "none (single cold pass)" if cold else f"{warm_s:.1f}s"
     print(
         f"# bench: mode={mode}{' (cold)' if cold else ''} rows={n_rows} "

@@ -8,6 +8,7 @@ BatchNormalStrategy.scala:33-95. Detail strings mirror the reference.
 from __future__ import annotations
 
 import math
+import sys
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
@@ -15,8 +16,13 @@ import numpy as np
 
 from deequ_tpu.anomaly.base import Anomaly, AnomalyDetectionStrategy
 
-_DBL_MIN = -math.inf
-_DBL_MAX = math.inf
+# the reference uses Double.MinValue/MaxValue, NOT infinities — the
+# distinction matters: a one-sided normal strategy multiplies the
+# missing side's factor by the stddev, and `inf * 0.0` is nan (which
+# poisons the bounds check and flags every point of a zero-variance
+# series), while `MaxValue * 0.0` is 0.
+_DBL_MIN = -sys.float_info.max
+_DBL_MAX = sys.float_info.max
 
 
 @dataclass

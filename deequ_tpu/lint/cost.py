@@ -201,6 +201,58 @@ class PlanCost:
         }
 
 
+def cost_drift(cost: "PlanCost", trace: Any) -> Dict[str, float]:
+    """Predicted-vs-observed drift per PlanCost field, from a RunTrace.
+
+    Positive values mean the run did *more* than the planner predicted
+    (extra passes/launches, more batches, wider wire rows). Keys:
+    `drift.counter.<name>`, `drift.span.<name>`, `drift.family_groups`,
+    and — when both sides are known — `drift.batches` and
+    `drift.wire_bytes_first_batch`. Feeds `engine.drift.*` in the
+    telemetry record so the sentinel can watch prediction quality as a
+    time series alongside throughput.
+    """
+    from deequ_tpu.observe import compare  # lazy: keep lint importable without observe
+
+    predicted = cost.dispatch_signature()
+    observed = compare.dispatch_signature(trace)
+    out: Dict[str, float] = {}
+    for key in set(predicted["counters"]) | set(observed["counters"]):
+        out[f"drift.counter.{key}"] = float(
+            observed["counters"].get(key, 0) - predicted["counters"].get(key, 0)
+        )
+    for key in set(predicted["spans"]) | set(observed["spans"]):
+        out[f"drift.span.{key}"] = float(
+            observed["spans"].get(key, 0) - predicted["spans"].get(key, 0)
+        )
+    out["drift.family_groups"] = float(
+        len(observed["family_groups"]) - len(predicted["family_groups"])
+    )
+
+    scan = cost.scan_pass
+    if scan is not None:
+        observed_batches = 0
+        saw_batches = False
+        first_wire: Optional[int] = None
+        for sp in trace.spans():
+            if sp.name in ("fused_scan", "dist_scan") and "batches" in sp.attrs:
+                observed_batches += int(sp.attrs["batches"])
+                saw_batches = True
+            elif (
+                first_wire is None
+                and sp.name == "dispatch"
+                and "wire_bytes" in sp.attrs
+            ):
+                first_wire = int(sp.attrs["wire_bytes"])
+        if saw_batches:
+            out["drift.batches"] = float(observed_batches - scan.n_batches)
+        if first_wire is not None and scan.wire_bytes_per_batch is not None:
+            out["drift.wire_bytes_first_batch"] = float(
+                first_wire - scan.wire_bytes_per_batch
+            )
+    return out
+
+
 # -- wire-format replay -------------------------------------------------------
 
 
@@ -275,6 +327,7 @@ def analyze_plan(
     num_hosts: int = 1,
     num_devices: int = 1,
     streaming: bool = False,
+    stream_batch_rows: Optional[int] = None,
     link_bandwidth: Optional[float] = None,
     pipeline_depth: Optional[int] = None,
 ) -> PlanCost:
@@ -286,7 +339,11 @@ def analyze_plan(
     `streaming=True` additionally predicts the stream pipeline's shape
     (`PlanCost.pipeline`): per-batch host vs wire seconds under the
     stated overlap model, with the link bandwidth taken from
-    `link_bandwidth` or the disk-cached placement probe."""
+    `link_bandwidth` or the disk-cached placement probe.
+    `stream_batch_rows` is the source's own per-batch row cap
+    (`ParquetSource.batch_rows`): a streamed source yields batches of
+    `min(batch_size, batch_rows)` rows, so the batch count and per-batch
+    wire bytes must honor it to stay trace-exact."""
     from deequ_tpu.analyzers.base import Preconditions, ScanShareableAnalyzer
     from deequ_tpu.analyzers.frequency import (
         FrequencyBasedAnalyzer,
@@ -358,11 +415,24 @@ def analyze_plan(
             eff_batch = (batch_size or (1 << 21)) * max(1, int(num_devices))
         else:
             eff_batch = batch_size or DEFAULT_BATCH_SIZE
-            if not use_device and batch_size is None and num_rows is not None:
+            if (
+                not use_device
+                and not streaming
+                and batch_size is None
+                and num_rows is not None
+            ):
                 # pure host fold over an in-memory table widens to one
-                # batch (FusedScanPass._run_pass host-widening rule)
+                # batch (FusedScanPass._run_pass host-widening rule;
+                # streamed sources never widen)
                 eff_batch = max(eff_batch, min(num_rows, 1 << 24))
-        batches = _n_batches(num_rows, eff_batch)
+        # a streaming source caps each batch at its own batch_rows
+        # (data/source.py: min(batch_size, batch_rows)); padding still
+        # follows the engine batch size, so `eff_batch` keeps feeding
+        # the _pad_size replay while `per_batch` drives the batch count
+        per_batch = eff_batch
+        if streaming and stream_batch_rows:
+            per_batch = min(per_batch, int(stream_batch_rows))
+        batches = _n_batches(num_rows, per_batch)
 
         device_keys = sorted(plan.device_keys)
         scan_columns: List[str] = []
@@ -390,7 +460,7 @@ def analyze_plan(
         )
 
         first_rows = (
-            min(num_rows, eff_batch) if num_rows is not None else eff_batch
+            min(num_rows, per_batch) if num_rows is not None else per_batch
         )
         wire_exact = (
             _predict_packed_bytes(

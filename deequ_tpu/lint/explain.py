@@ -371,6 +371,7 @@ def explain_plan(
     num_hosts: int = 1,
     num_devices: int = 1,
     streaming: Optional[bool] = None,
+    stream_batch_rows: Optional[int] = None,
     link_bandwidth: Optional[float] = None,
     pipeline_depth: Optional[int] = None,
 ) -> ExplainResult:
@@ -378,7 +379,8 @@ def explain_plan(
     are taken from it — still zero data scanned) or a `SchemaInfo`.
 
     `streaming` defaults to the table's own `is_streaming` (False for a
-    bare `SchemaInfo`); streaming plans additionally predict the stream
+    bare `SchemaInfo`), and `stream_batch_rows` to the table's own
+    per-batch row cap; streaming plans additionally predict the stream
     pipeline's overlap shape and the DQ305 queue-depth lint, with the
     link bandwidth from `link_bandwidth` or the cached placement probe."""
     if isinstance(data_or_schema, SchemaInfo):
@@ -389,6 +391,9 @@ def explain_plan(
             num_rows = int(data_or_schema.num_rows)
         if streaming is None:
             streaming = bool(getattr(data_or_schema, "is_streaming", False))
+        if stream_batch_rows is None and streaming:
+            cap = getattr(data_or_schema, "batch_rows", None)
+            stream_batch_rows = int(cap) if cap else None
     plan = _plan_analyzers(analyzers, checks)
     cost = analyze_plan(
         plan,
@@ -400,6 +405,7 @@ def explain_plan(
         num_hosts=num_hosts,
         num_devices=num_devices,
         streaming=bool(streaming),
+        stream_batch_rows=stream_batch_rows,
         link_bandwidth=link_bandwidth,
         pipeline_depth=pipeline_depth,
     )

@@ -384,6 +384,8 @@ def validate_plan(
     mode: str = "lenient",
     num_rows: Optional[int] = None,
     batch_size: Optional[int] = None,
+    streaming: bool = False,
+    stream_batch_rows: Optional[int] = None,
 ) -> LintReport:
     """Run the full static pass: semantic lints (DQ1xx/DQ2xx) plus the
     cost analyzer's performance lints (DQ3xx, lint/explain.py). The
@@ -402,7 +404,12 @@ def validate_plan(
 
         plan = _plan_analyzers(required_analyzers, checks)
         report.plan_cost = analyze_plan(
-            plan, schema, num_rows=num_rows, batch_size=batch_size
+            plan,
+            schema,
+            num_rows=num_rows,
+            batch_size=batch_size,
+            streaming=streaming,
+            stream_batch_rows=stream_batch_rows,
         )
         report.extend(cost_diagnostics(report.plan_cost, plan, schema))
     except Exception:  # noqa: BLE001 — cost lint must never break a run

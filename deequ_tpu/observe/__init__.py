@@ -60,6 +60,14 @@ from deequ_tpu.observe.runtrace import (
     env_enabled,
     traced_run,
 )
+from deequ_tpu.observe import heartbeat
+from deequ_tpu.observe.heartbeat import scan_heartbeat
+from deequ_tpu.observe.telemetry import (
+    engine_metric_record,
+    latest_results,
+    openmetrics_text,
+    proc_resources,
+)
 
 __all__ = [
     "Span",
@@ -86,8 +94,14 @@ __all__ = [
     "RunTrace",
     "default_trace_path",
     "dispatch_signature",
+    "engine_metric_record",
     "env_enabled",
+    "heartbeat",
+    "latest_results",
     "observed_family_groups",
+    "openmetrics_text",
+    "proc_resources",
+    "scan_heartbeat",
     "span_name_counts",
     "traced_run",
 ]

@@ -169,6 +169,23 @@ def _counter_delta(
     }
 
 
+def _set_resource_attrs(run_span: Span) -> None:
+    """Stamp peak RSS / major faults on the run span (from /proc, no
+    psutil), so run reports and bench records carry their own resource
+    accounting. Both values are process-cumulative: for nested runs
+    they describe the process at run end, not the run's own delta."""
+    from deequ_tpu.observe import telemetry
+
+    try:
+        res = telemetry.proc_resources()
+    except Exception:
+        return
+    if "peak_rss_mb" in res:
+        run_span.set(peak_rss_mb=round(res["peak_rss_mb"], 2))
+    if "major_faults" in res:
+        run_span.set(major_faults=int(res["major_faults"]))
+
+
 @contextlib.contextmanager
 def traced_run(
     name: str, enable: Any = None, **attrs: Any
@@ -185,6 +202,7 @@ def traced_run(
             finally:
                 delta = _counter_delta(active, before)
                 run_span.set(**delta)
+                _set_resource_attrs(run_span)
                 handle.trace = RunTrace(run_span, active.epoch, delta)
         return
 
@@ -217,6 +235,7 @@ def traced_run(
             finally:
                 delta = _counter_delta(tracer, before)
                 run_span.set(**delta)
+                _set_resource_attrs(run_span)
                 handle.trace = RunTrace(run_span, tracer.epoch, delta)
     if out_path is not None and handle.trace is not None:
         try:
@@ -227,10 +246,9 @@ def traced_run(
             handle.trace.path = out_path
             if out_path not in _announced_paths:
                 _announced_paths.add(out_path)
-                print(
+                sys.stderr.write(
                     f"# deequ_tpu: trace -> {out_path} "
-                    f"(load in https://ui.perfetto.dev)",
-                    file=sys.stderr,
+                    f"(load in https://ui.perfetto.dev)\n"
                 )
         except OSError:
             pass

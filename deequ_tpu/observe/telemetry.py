@@ -1,0 +1,268 @@
+"""Engine telemetry: flat metric records, /proc resources, OpenMetrics.
+
+The paper's product loop stores *data-quality* metrics in a repository
+and runs anomaly detection over the resulting time series.  This module
+turns the *engine's own health* into the same shape: a traced run (plus
+its optional PlanCost prediction) flattens into one `Dict[str, float]`
+record — throughput, per-phase seconds, exact wire bytes, pipeline
+stage occupancy, peak RSS, predicted-vs-observed drift — that
+`deequ_tpu.repository.engine` persists through the ordinary
+`MetricsRepository`, so one store holds both kinds of series and one
+anomaly stack (tools/sentinel.py) watches both.
+
+Also here: an OpenMetrics / Prometheus text exporter over repository
+results, ready for a future service layer to scrape.
+
+Design constraints (same as the rest of `observe/`): no deequ_tpu
+dependencies outside this package at import time — the repository and
+lint layers are imported lazily inside functions, so `observe` stays
+importable from every engine layer without cycles.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import resource
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from deequ_tpu.observe import report
+
+__all__ = [
+    "ENGINE_PREFIX",
+    "engine_metric_record",
+    "latest_results",
+    "openmetrics_text",
+    "proc_resources",
+]
+
+#: every key in an engine metric record starts with this prefix, which is
+#: what lets the exporter and the sentinel tell engine series apart from
+#: data-quality metrics sharing the same repository.
+ENGINE_PREFIX = "engine."
+
+#: span names whose `rows`/`batches` attributes count scanned work.
+_SCAN_SPANS = ("fused_scan", "dist_scan")
+
+
+# ---------------------------------------------------------------------------
+# /proc resource accounting (satellite: no psutil dependency)
+# ---------------------------------------------------------------------------
+
+
+def proc_resources() -> Dict[str, float]:
+    """Peak RSS (MB) and cumulative major page faults for this process.
+
+    Reads `/proc/self/status` (VmHWM) and `/proc/self/stat` (majflt,
+    field 12); falls back to `resource.getrusage` where /proc is absent
+    so callers never need an external measurement tool.
+    """
+    out: Dict[str, float] = {}
+    try:
+        with open("/proc/self/status", encoding="ascii") as fh:
+            for line in fh:
+                if line.startswith("VmHWM:"):
+                    out["peak_rss_mb"] = float(line.split()[1]) / 1024.0
+                    break
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        with open("/proc/self/stat", encoding="ascii") as fh:
+            # comm may contain spaces/parens: split after the closing paren,
+            # which leaves state at index 0 and majflt (field 12) at index 9.
+            tail = fh.read().rsplit(")", 1)[1].split()
+        out["major_faults"] = float(int(tail[9]))
+    except (OSError, ValueError, IndexError):
+        pass
+    if "peak_rss_mb" not in out or "major_faults" not in out:
+        usage = resource.getrusage(resource.RUSAGE_SELF)
+        # linux reports ru_maxrss in KB
+        out.setdefault("peak_rss_mb", usage.ru_maxrss / 1024.0)
+        out.setdefault("major_faults", float(usage.ru_majflt))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# flat engine metric record
+# ---------------------------------------------------------------------------
+
+
+def engine_metric_record(
+    trace: Any,
+    plan_cost: Any = None,
+    *,
+    extra: Optional[Dict[str, float]] = None,
+) -> Dict[str, float]:
+    """Flatten a RunTrace (and optional PlanCost) into one metric record.
+
+    Keys are `engine.`-prefixed floats: wall/CPU seconds, scanned
+    rows/batches and rows/s, summed dispatch wire bytes, disjoint
+    per-phase self seconds, per-stage pipeline occupancy, trace
+    counters, peak RSS / major faults, and — when `plan_cost` is given —
+    `engine.drift.*` predicted-vs-observed deltas per PlanCost field
+    (see `deequ_tpu.lint.cost.cost_drift`).
+    """
+    root = trace.root
+    wall = float(trace.duration_s)
+    rec: Dict[str, float] = {
+        "engine.wall_s": wall,
+        "engine.cpu_s": float(root.cpu_s),
+    }
+
+    rows = 0
+    batches = 0
+    saw_scan = False
+    wire = 0
+    saw_wire = False
+    for sp in trace.spans():
+        if sp.name in _SCAN_SPANS:
+            attrs = sp.attrs
+            if "rows" in attrs or "batches" in attrs:
+                rows += int(attrs.get("rows", 0))
+                batches += int(attrs.get("batches", 0))
+                saw_scan = True
+        elif sp.name == "dispatch" and "wire_bytes" in sp.attrs:
+            wire += int(sp.attrs["wire_bytes"])
+            saw_wire = True
+    if saw_scan:
+        rec["engine.rows"] = float(rows)
+        rec["engine.batches"] = float(batches)
+        if wall > 0.0:
+            rec["engine.rows_per_s"] = rows / wall
+    if saw_wire:
+        rec["engine.wire_bytes"] = float(wire)
+
+    for phase, secs in trace.phase_seconds().items():
+        if secs > 0.0 or phase in report.PHASES:
+            rec[f"engine.phase.{phase}_s"] = float(secs)
+
+    for row in report.pipeline_occupancy([root]):
+        stage = str(row["stage"])
+        rec[f"engine.pipeline.{stage}.occupancy"] = float(row["occupancy"])
+        rec[f"engine.pipeline.{stage}.busy_s"] = float(row["busy_s"])
+        rec[f"engine.pipeline.{stage}.stall_s"] = float(row["stall_s"])
+
+    for key, value in trace.counters.items():
+        if isinstance(value, (int, float)):
+            rec[f"engine.counter.{key}"] = float(value)
+
+    # satellite: traced_run stamps these on the root span; live /proc read
+    # covers traces produced before the attributes existed.
+    res = proc_resources()
+    rec["engine.peak_rss_mb"] = float(root.attrs.get("peak_rss_mb", res.get("peak_rss_mb", 0.0)))
+    rec["engine.major_faults"] = float(root.attrs.get("major_faults", res.get("major_faults", 0.0)))
+
+    if plan_cost is not None:
+        from deequ_tpu.lint.cost import cost_drift  # lazy: observe must not need lint at import
+
+        for key, value in cost_drift(plan_cost, trace).items():
+            rec[f"engine.{key}"] = float(value)
+
+    if extra:
+        for key, value in extra.items():
+            name = key if key.startswith(ENGINE_PREFIX) else ENGINE_PREFIX + key
+            rec[name] = float(value)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics / Prometheus exposition
+# ---------------------------------------------------------------------------
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_OK = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(prefix: str, raw: str) -> str:
+    name = _NAME_OK.sub("_", f"{prefix}_{raw}")
+    if name[:1].isdigit():
+        name = "_" + name
+    return name
+
+
+def _label_name(raw: str) -> str:
+    name = _LABEL_OK.sub("_", raw)
+    if not name or name[:1].isdigit():
+        name = "_" + name
+    return name
+
+
+def _escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(labels: Sequence[Tuple[str, str]]) -> str:
+    if not labels:
+        return ""
+    body = ",".join(f'{_label_name(k)}="{_escape(str(v))}"' for k, v in labels)
+    return "{" + body + "}"
+
+
+def latest_results(results: Iterable[Any]) -> List[Any]:
+    """Keep the newest result per distinct tag set (by data_set_date).
+
+    OpenMetrics forbids duplicate label sets within a family, so a
+    scrape exposes the *latest* point of each series; history stays in
+    the repository for the sentinel.
+    """
+    by_tags: Dict[Tuple[Tuple[str, str], ...], Any] = {}
+    for res in results:
+        key = tuple(sorted(res.result_key.tags.items()))
+        cur = by_tags.get(key)
+        if cur is None or res.result_key.data_set_date >= cur.result_key.data_set_date:
+            by_tags[key] = res
+    return [by_tags[key] for key in sorted(by_tags)]
+
+
+def openmetrics_text(results: Iterable[Any], *, prefix: str = "deequ_tpu") -> str:
+    """Render repository results as OpenMetrics exposition text.
+
+    Engine telemetry metrics (names under `engine.`) become one gauge
+    family each (`<prefix>_engine_rows_per_s{...}`); data-quality
+    metrics share a single `<prefix>_metric` family labelled by
+    metric/instance/entity.  Result-key tags become labels on every
+    sample.  Failed and non-finite metric values are skipped.  Output
+    ends with the mandatory `# EOF` terminator.
+    """
+    families: Dict[str, List[str]] = {}
+    seen: set = set()
+
+    def _emit(family: str, labels: List[Tuple[str, str]], value: float) -> None:
+        if not math.isfinite(value):
+            return
+        label_str = _label_str(labels)
+        dedupe = (family, label_str)
+        if dedupe in seen:
+            return
+        seen.add(dedupe)
+        families.setdefault(family, []).append(f"{family}{label_str} {value!r}")
+
+    dq_family = _metric_name(prefix, "metric")
+    for res in latest_results(results):
+        tags = sorted(res.result_key.tags.items())
+        for metric in res.analyzer_context.metric_map.values():
+            for flat in metric.flatten():
+                if not flat.value.is_success:
+                    continue
+                try:
+                    value = float(flat.value.get())
+                except (TypeError, ValueError):
+                    continue
+                if flat.name.startswith(ENGINE_PREFIX):
+                    family = _metric_name(prefix, flat.name.replace(".", "_"))
+                    labels = [("instance", flat.instance)] + list(tags)
+                else:
+                    family = dq_family
+                    labels = [
+                        ("metric", flat.name),
+                        ("instance", flat.instance),
+                        ("entity", getattr(flat.entity, "value", str(flat.entity))),
+                    ] + list(tags)
+                _emit(family, labels, value)
+
+    lines: List[str] = []
+    for family in sorted(families):
+        lines.append(f"# TYPE {family} gauge")
+        lines.extend(sorted(families[family]))
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"

@@ -1216,81 +1216,113 @@ class FusedScanPass:
             # machinery and sketch folds. Capped at ~16M rows so
             # worst-case kernel scratch stays bounded.
             batch_size = max(batch_size, min(table.num_rows, 1 << 24))
-        if streaming and runtime.pipeline_enabled():
-            scanned_rows, scanned_batches, device_error = self._scan_pipelined(
-                table, batch_size, analyzers, assisted, specs,
-                device_spec_keys, use_device, dtype, sticky, fold,
-                host_members, host_assisted, host_member_keys,
-                host_aggs, host_assisted_states, host_errors, family_memo,
-            )
-        else:
-            for batch in table.batches(batch_size):
-                # per-key builds with error capture: a failing input (e.g.
-                # a predicate over a missing column) fails only the
-                # analyzers that need it — host members individually, the
-                # device group as a whole (reference:
-                # AnalysisRunner.scala:310-313). Only keys with a
-                # still-live consumer are built at all.
-                live_keys: set = set()
-                if use_device and device_error is None:
-                    live_keys.update(device_spec_keys)
-                for i, _member in all_host:
-                    if i not in host_errors:
-                        live_keys.update(host_member_keys[i])
-                device_live = use_device and device_error is None
-                host_live = any(i not in host_errors for i, _m in all_host)
-                if not device_live and not host_live:
-                    break  # everything already failed; stop scanning
-                # device keys build eagerly (the shared program needs them
-                # packed); host-only keys build lazily on member access
-                built = HostInputs(specs, batch)
-                build_errors = built.build_errors
-                if device_live:
-                    for key in device_spec_keys:
-                        built.materialize(key)
-                if use_device and device_error is None:
-                    try:
-                        with observe.span(
-                            "dispatch", cat="dispatch", rows=batch.num_rows
-                        ) as dispatch_sp:
-                            for key in device_spec_keys:
-                                if key in build_errors:
-                                    raise build_errors[key]
-                            padded = _pad_size(batch.num_rows, self.batch_size)
-                            packed_inputs, layout = pack_batch_inputs(
-                                [(k, built[k]) for k in device_spec_keys],
-                                padded, dtype, sticky, num_rows=batch.num_rows,
-                            )
-                            if dispatch_sp:
-                                dispatch_sp.set(
-                                    wire_bytes=int(
-                                        sum(
-                                            int(getattr(v, "nbytes", 0))
-                                            for v in packed_inputs.values()
+        hb_total_rows: Optional[int] = None
+        try:
+            raw_rows = getattr(table, "num_rows", None)
+            if raw_rows is not None:
+                hb_total_rows = int(raw_rows)
+        except (TypeError, ValueError):
+            hb_total_rows = None
+        # a streaming source caps its own batches at `batch_rows`
+        # (data/source.py uses min(batch_size, batch_rows)), so the
+        # batch-count prediction must apply the same cap
+        hb_batch = batch_size
+        try:
+            raw_cap = getattr(table, "batch_rows", None)
+            if streaming and raw_cap:
+                hb_batch = min(hb_batch, int(raw_cap))
+        except (TypeError, ValueError):
+            pass
+        progress = observe.heartbeat.start(
+            runtime.heartbeat_s(),
+            total_rows=hb_total_rows,
+            predicted_batches=(
+                None
+                if hb_total_rows is None
+                else max(1, -(-hb_total_rows // hb_batch))
+            ),
+            name="fused_scan",
+        )
+        try:
+            if streaming and runtime.pipeline_enabled():
+                scanned_rows, scanned_batches, device_error = self._scan_pipelined(
+                    table, batch_size, analyzers, assisted, specs,
+                    device_spec_keys, use_device, dtype, sticky, fold,
+                    host_members, host_assisted, host_member_keys,
+                    host_aggs, host_assisted_states, host_errors, family_memo,
+                    progress=progress,
+                )
+            else:
+                for batch in table.batches(batch_size):
+                    # per-key builds with error capture: a failing input (e.g.
+                    # a predicate over a missing column) fails only the
+                    # analyzers that need it — host members individually, the
+                    # device group as a whole (reference:
+                    # AnalysisRunner.scala:310-313). Only keys with a
+                    # still-live consumer are built at all.
+                    live_keys: set = set()
+                    if use_device and device_error is None:
+                        live_keys.update(device_spec_keys)
+                    for i, _member in all_host:
+                        if i not in host_errors:
+                            live_keys.update(host_member_keys[i])
+                    device_live = use_device and device_error is None
+                    host_live = any(i not in host_errors for i, _m in all_host)
+                    if not device_live and not host_live:
+                        break  # everything already failed; stop scanning
+                    # device keys build eagerly (the shared program needs them
+                    # packed); host-only keys build lazily on member access
+                    built = HostInputs(specs, batch)
+                    build_errors = built.build_errors
+                    if device_live:
+                        for key in device_spec_keys:
+                            built.materialize(key)
+                    if use_device and device_error is None:
+                        try:
+                            with observe.span(
+                                "dispatch", cat="dispatch", rows=batch.num_rows
+                            ) as dispatch_sp:
+                                for key in device_spec_keys:
+                                    if key in build_errors:
+                                        raise build_errors[key]
+                                padded = _pad_size(batch.num_rows, self.batch_size)
+                                packed_inputs, layout = pack_batch_inputs(
+                                    [(k, built[k]) for k in device_spec_keys],
+                                    padded, dtype, sticky, num_rows=batch.num_rows,
+                                )
+                                if dispatch_sp:
+                                    dispatch_sp.set(
+                                        wire_bytes=int(
+                                            sum(
+                                                int(getattr(v, "nbytes", 0))
+                                                for v in packed_inputs.values()
+                                            )
                                         )
                                     )
+                                fused, meta_box = get_fused_fn(
+                                    analyzers, assisted, layout
                                 )
-                            fused, meta_box = get_fused_fn(
-                                analyzers, assisted, layout
-                            )
-                            runtime.record_launch()
-                            # async dispatch: the device crunches this
-                            # batch while the host folds the previous
-                            # batch (and the host members below)
-                            fold.submit(
-                                fused(packed_inputs), meta_box, host_ctx=built
-                            )
-                    except Exception as e:  # noqa: BLE001
-                        device_error = e
-                with observe.span("host_fold", cat="host", rows=batch.num_rows):
-                    fold_host_batch(
-                        built, build_errors, host_members, host_assisted,
-                        host_member_keys, host_aggs, host_assisted_states,
-                        host_errors, batch=batch, streaming=streaming,
-                        family_memo=family_memo,
-                    )
-                scanned_rows += batch.num_rows
-                scanned_batches += 1
+                                runtime.record_launch()
+                                # async dispatch: the device crunches this
+                                # batch while the host folds the previous
+                                # batch (and the host members below)
+                                fold.submit(
+                                    fused(packed_inputs), meta_box, host_ctx=built
+                                )
+                        except Exception as e:  # noqa: BLE001
+                            device_error = e
+                    with observe.span("host_fold", cat="host", rows=batch.num_rows):
+                        fold_host_batch(
+                            built, build_errors, host_members, host_assisted,
+                            host_member_keys, host_aggs, host_assisted_states,
+                            host_errors, batch=batch, streaming=streaming,
+                            family_memo=family_memo,
+                        )
+                    scanned_rows += batch.num_rows
+                    scanned_batches += 1
+                    progress.advance(batch.num_rows)
+        finally:
+            progress.finish()
 
         observe.annotate(rows=scanned_rows, batches=scanned_batches)
         aggs, assisted_states = [], []
@@ -1331,6 +1363,7 @@ class FusedScanPass:
         host_assisted_states,
         host_errors,
         family_memo,
+        progress=observe.heartbeat.NOOP_PROGRESS,
     ):
         """The pipelined streaming consumer loop (`DEEQU_TPU_PIPELINE`):
         per-batch prep — eager device-key builds, wire packing with its
@@ -1397,7 +1430,9 @@ class FusedScanPass:
         scanned_rows = 0
         scanned_batches = 0
         device_error: Optional[BaseException] = None
-        items = pipeline.staged(table.batches(batch_size), _prep, name="prep")
+        items = pipeline.staged(
+            table.batches(batch_size), _prep, name="prep", progress=progress
+        )
         with contextlib.closing(items):
             with observe.span(
                 "pipe_stage", cat="pipeline", stage="fold"
@@ -1408,7 +1443,7 @@ class FusedScanPass:
                     host_live = any(i not in host_errors for i, _m in all_host)
                     if not device_live and not host_live:
                         break  # everything already failed; stop scanning
-                    with observe.span(
+                    with progress.timed("fold"), observe.span(
                         "pipe_item", cat="pipeline", stage="fold",
                         rows=batch.num_rows,
                     ):
@@ -1444,6 +1479,7 @@ class FusedScanPass:
                             )
                     scanned_rows += batch.num_rows
                     scanned_batches += 1
+                    progress.advance(batch.num_rows)
                 if stage_sp:
                     stage_sp.set(items=scanned_batches)
         return scanned_rows, scanned_batches, device_error

@@ -61,6 +61,7 @@ def staged(
     *,
     name: str = "prep",
     depth: int | None = None,
+    progress: Any = None,
 ) -> Iterator[Any]:
     """Run `fn` over `iterable`'s items on a dedicated stage thread,
     yielding `fn(item)` results in input order through a bounded queue.
@@ -84,9 +85,17 @@ def staged(
     Trace context is captured when the consumer starts iterating and
     adopted by the stage thread, so `fn`'s spans stay under the
     dispatching scan's subtree.
+
+    `progress` is an optional live-heartbeat handle
+    (`observe.heartbeat.ScanProgress`): the stage accounts the upstream
+    `next()` wait to the `decode` stage bucket and `fn`'s work to this
+    stage's bucket, which is what the heartbeat's bottleneck/occupancy
+    snapshot reads. Defaults to the no-op handle.
     """
     if depth is None:
         depth = runtime.pipeline_depth()
+    if progress is None:
+        progress = observe.heartbeat.NOOP_PROGRESS
     q: "queue.Queue[Any]" = queue.Queue(maxsize=max(1, depth))
     stop = threading.Event()
     error: List[BaseException] = []
@@ -115,13 +124,14 @@ def staged(
                         # stage's work — kept outside the item span so
                         # occupancy attributes it to the right stage
                         try:
-                            item = next(it)
+                            with progress.timed("decode"):
+                                item = next(it)
                         except StopIteration:
                             break
                         sp = observe.span(
                             "pipe_item", cat="pipeline", stage=name
                         )
-                        with sp:
+                        with sp, progress.timed(name):
                             rows = getattr(item, "num_rows", None)
                             if sp and rows is not None:
                                 sp.set(rows=int(rows))

@@ -237,6 +237,20 @@ def source_stall_s() -> float:
         return 0.0
 
 
+def heartbeat_s() -> float:
+    """Live scan heartbeat interval in seconds (`DEEQU_TPU_HEARTBEAT_S`,
+    default 0 = off): when positive, streaming scans emit periodic
+    progress snapshots — completed/predicted batches, instantaneous
+    rows/s, pipeline-stage bottleneck, ETA — through
+    `observe.heartbeat` (registered callbacks, or JSONL lines at
+    `DEEQU_TPU_HEARTBEAT_OUT`, falling back to stderr). Disabled, the
+    scan loop touches only a falsy no-op handle and no timer thread is
+    ever spawned."""
+    from deequ_tpu.observe import heartbeat
+
+    return heartbeat.env_interval_s()
+
+
 def _platform_key() -> Optional[str]:
     """Identity of the attached LINK — the cache key. Bandwidth is a
     property of how THIS HOST reaches the device, not of the device kind

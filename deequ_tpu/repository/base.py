@@ -71,12 +71,18 @@ class AnalysisResult:
 
 
 def _sanitize_tag_column(tag: str, existing_row: Dict[str, object]) -> str:
-    """Sanitize tag names for column use; suffix `_2` on collision
+    """Sanitize tag names for column use; on collision with a column the
+    row already has, suffix `_2`, `_3`, ... until free (a fixed `_2`
+    suffix can itself collide — e.g. tags `a b` and `a.b` with a metric
+    column `a_b_2` — and would silently overwrite a value).
     (reference: AnalysisResult.scala tag handling)."""
     sanitized = re.sub(r"[^A-Za-z0-9_]", "_", tag)
-    if sanitized in existing_row:
-        sanitized = f"{sanitized}_2"
-    return sanitized
+    if sanitized not in existing_row:
+        return sanitized
+    n = 2
+    while f"{sanitized}_{n}" in existing_row:
+        n += 1
+    return f"{sanitized}_{n}"
 
 
 class MetricsRepository:

@@ -190,6 +190,14 @@ def serialize_analyzer(analyzer: Analyzer) -> Dict[str, Any]:
             "quantiles": ",".join(str(q) for q in analyzer.quantiles),
             "relativeError": analyzer.relative_error,
         }
+    from deequ_tpu.repository.engine import EngineMetric
+
+    if isinstance(analyzer, EngineMetric):
+        return {
+            ANALYZER_NAME_FIELD: "EngineMetric",
+            "metric": analyzer.metric,
+            "instance": analyzer.instance,
+        }
     raise ValueError(f"Unable to serialize analyzer {analyzer!r}.")
 
 
@@ -242,6 +250,10 @@ def deserialize_analyzer(data: Dict[str, Any]) -> Analyzer:
     if name == "ApproxQuantiles":
         quantiles = [float(q) for q in data["quantiles"].split(",")]
         return ApproxQuantiles(data[COLUMN_FIELD], quantiles, data["relativeError"])
+    if name == "EngineMetric":
+        from deequ_tpu.repository.engine import EngineMetric
+
+        return EngineMetric(data["metric"], data.get("instance", "engine"))
     raise ValueError(f"Unable to deserialize analyzer {name}.")
 
 

@@ -200,12 +200,16 @@ class AnalysisRunner:
             return [], None
         try:
             schema = SchemaInfo.from_table(data)
+            streaming = bool(getattr(data, "is_streaming", False))
+            cap = getattr(data, "batch_rows", None) if streaming else None
             report = validate_plan(
                 schema,
                 checks=(),
                 required_analyzers=analyzers,
                 mode=mode,
                 num_rows=int(data.num_rows),
+                streaming=streaming,
+                stream_batch_rows=int(cap) if cap else None,
             )
             return list(report.diagnostics), report.plan_cost
         except PlanValidationError:

@@ -145,6 +145,18 @@ class TestOnlineNormalBoundaries:
         found = [i for i, _ in s.detect(self._series(), (45, 60))]
         assert 40 not in found
 
+    def test_one_sided_constant_series_not_flagged(self):
+        # zero variance + a one-sided factor: the missing side's bound
+        # is mean ± MaxValue·0 = mean, so an unchanged value stays in
+        # bounds (regression: math.inf · 0 = nan flagged every point)
+        series = [5.0] * 20
+        for s in (
+            OnlineNormalStrategy(lower_deviation_factor=None),
+            OnlineNormalStrategy(upper_deviation_factor=None),
+            OnlineNormalStrategy(),
+        ):
+            assert s.detect(series, (0, 20)) == []
+
 
 class TestBatchNormalBoundaries:
     def test_interval_excluded_from_stats(self):

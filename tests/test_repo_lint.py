@@ -320,6 +320,51 @@ def test_globalmut_respects_local_shadowing_and_global_decl():
     assert all("declared" not in f or "'k'" not in f for f in findings)
 
 
+# -- OBSPRINT: print() in observability code (ISSUE 6 satellite) -------------
+
+
+def test_obsprint_checker_flags_print():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def emit(snapshot):\n"
+        "    print(snapshot)\n"
+    )
+    try:
+        findings = lint.check_observe_prints(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+    assert "OBSPRINT" in findings[0]
+
+
+def test_obsprint_allows_stderr_write():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import sys\n"
+        "def emit(line):\n"
+        "    sys.stderr.write(line)\n"
+    )
+    try:
+        findings = lint.check_observe_prints(path)
+    finally:
+        os.unlink(path)
+    assert findings == []
+
+
+def test_obsprint_rule_scopes_to_observe_dir():
+    """The ban covers deequ_tpu/observe only — results code elsewhere
+    may still print to stdout deliberately."""
+    lint = _lint_module()
+    sep = os.sep
+    covered = f"deequ_tpu{sep}observe{sep}heartbeat.py"
+    exempt = f"deequ_tpu{sep}runners{sep}analysis_runner.py"
+    in_scope = lambda rel: any(  # noqa: E731 - mirror of main()'s filter
+        rel == d or rel.startswith(d + sep) for d in lint.OBSPRINT_DIRS
+    )
+    assert in_scope(covered)
+    assert not in_scope(exempt)
+
+
 def test_globalmut_reads_are_not_findings():
     lint = _lint_module()
     path = _tmp_source(

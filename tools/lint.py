@@ -26,6 +26,11 @@ Checks:
              exact bug class the ExecutionStats fix in PR 3 removed.
              Guard the mutation with `with <...lock...>:` or allowlist
              the ASSIGNMENT line with a `# global-ok: <reason>` comment.
+  OBSPRINT — no `print(...)` calls in deequ_tpu/observe/: heartbeat and
+             trace output must flow through a sink, callback, or
+             explicit stream write (`sys.stderr.write`) — stdout
+             belongs to results (bench.py's one-JSON-line contract) and
+             a stray print corrupts any caller parsing it.
   F401*    — unused imports (fallback when ruff is unavailable).
   E722*    — bare `except:` (fallback when ruff is unavailable).
 
@@ -71,6 +76,9 @@ GLOBALMUT_DIRS = (
     os.path.join("deequ_tpu", "runners"),
     os.path.join("deequ_tpu", "parallel"),
 )
+# Dirs where `print(` is banned outright: observability output must go
+# through a sink/callback/stream-write, never stdout.
+OBSPRINT_DIRS = (os.path.join("deequ_tpu", "observe"),)
 GLOBALMUT_MUTATORS = {
     "append",
     "extend",
@@ -197,6 +205,26 @@ def check_timing_calls(path: str) -> List[str]:
                 f"the measurement lands in the trace"
             )
     return findings
+
+
+# -- OBSPRINT: print() in observability code ---------------------------------
+
+
+def check_observe_prints(path: str) -> List[str]:
+    """Flag any `print(...)` call in deequ_tpu/observe/: heartbeat and
+    trace announcements must use a registered sink/callback or an
+    explicit `sys.stderr.write` — stdout is reserved for results."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    return [
+        f"{_rel(path)}:{node.lineno}: OBSPRINT `print(...)` in "
+        f"observability code — emit through a sink/callback or "
+        f"`sys.stderr.write`; stdout belongs to results"
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "print"
+    ]
 
 
 # -- GLOBALMUT: unguarded module-global mutable state ------------------------
@@ -479,6 +507,10 @@ def main() -> int:
             rel == d or rel.startswith(d + os.sep) for d in GLOBALMUT_DIRS
         ):
             findings.extend(check_global_mutation(path))
+        if any(
+            rel == d or rel.startswith(d + os.sep) for d in OBSPRINT_DIRS
+        ):
+            findings.extend(check_observe_prints(path))
 
     if shutil.which("ruff") is not None:
         findings.extend(run_ruff())

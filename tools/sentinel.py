@@ -1,0 +1,288 @@
+#!/usr/bin/env python3
+"""Regression sentinel: anomaly detection over engine telemetry series.
+
+Loads engine metric time series from two sources and runs the repo's own
+anomaly strategies over them, exiting nonzero with a human-readable
+verdict when throughput or phase shares regress:
+
+  * a metrics repository JSON file (default `ENGINE_METRICS.json` at the
+    repo root — what bench.py appends to; see BENCH.md), filtered to
+    `telemetry=engine` result keys via `deequ_tpu.repository.engine`;
+  * the committed `BENCH_r0*.json` history (headline rows/s per round).
+
+Detection per series (union of what each strategy flags):
+
+  * `RateOfChangeStrategy` over log-values — scale-free relative step
+    detection; a drop of more than `--max-drop` (default 20%) between
+    consecutive points flags (for up-is-bad series: a rise of more than
+    the same fraction);
+  * `OnlineNormalStrategy` one-sided at 3 sigma — drift detection
+    against the running mean (lower side for throughput, upper side for
+    phase shares);
+  * `HoltWinters` (daily/weekly) on series long enough for two full
+    cycles plus a test window — catches seasonal-shape breaks.
+
+Usage: `make sentinel`, or
+  python tools/sentinel.py [--repo PATH] [--bench GLOB] [--max-drop F]
+
+Exit status: 0 = ok (or not enough history), 1 = regression flagged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: engine series watched from the metrics repository, with regression
+#: direction ("down" = drops are bad, "up" = rises are bad)
+WATCHED_SERIES: Sequence[Tuple[str, str]] = (
+    ("engine.rows_per_s", "down"),
+    ("engine.peak_rss_mb", "up"),
+)
+
+#: phases whose share of wall time is watched (rises are bad: a phase
+#: eating a larger fraction of the run means a new bottleneck)
+WATCHED_PHASE_SHARES: Sequence[str] = ("dispatch", "transfer", "merge", "host")
+
+#: minimum points before a series is judged at all
+MIN_POINTS = 4
+
+#: HoltWinters needs two full weekly cycles of training plus a test window
+HW_MIN_POINTS = 15
+
+
+def _ensure_repo_on_path() -> None:
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+
+
+def detect_regressions(
+    points: Sequence[Any],
+    *,
+    direction: str = "down",
+    max_drop: float = 0.2,
+) -> List[Dict[str, Any]]:
+    """Run the strategy union over one series of anomaly DataPoints.
+
+    Returns one finding dict per flagged point: {time, value, detail,
+    strategies}. Points whose metric_value is None are dropped first.
+    """
+    _ensure_repo_on_path()
+    from deequ_tpu.anomaly import (
+        HoltWinters,
+        MetricInterval,
+        OnlineNormalStrategy,
+        RateOfChangeStrategy,
+        SeriesSeasonality,
+    )
+
+    series = [p for p in points if p.metric_value is not None]
+    series.sort(key=lambda p: p.time)
+    values = [float(p.metric_value) for p in series]
+    times = [p.time for p in series]
+    n = len(values)
+    if n < MIN_POINTS:
+        return []
+
+    flagged: Dict[int, Dict[str, Any]] = {}
+
+    def _flag(index: int, strategy: str, detail: str) -> None:
+        if not (0 <= index < n):
+            return
+        entry = flagged.setdefault(
+            index,
+            {
+                "time": times[index],
+                "value": values[index],
+                "strategies": [],
+                "detail": detail,
+            },
+        )
+        if strategy not in entry["strategies"]:
+            entry["strategies"].append(strategy)
+
+    # 1) relative step detection on log-values (scale-free): a drop
+    # below (1 - max_drop)x, or a rise above 1/(1 - max_drop)x for
+    # up-is-bad series, between consecutive points
+    if all(v > 0.0 for v in values):
+        logs = [math.log(v) for v in values]
+        bound = math.log(1.0 - max_drop)
+        if direction == "down":
+            roc = RateOfChangeStrategy(max_rate_decrease=bound)
+        else:
+            roc = RateOfChangeStrategy(max_rate_increase=-bound)
+        for idx, anomaly in roc.detect(logs, (1, n)):
+            prev = values[idx - 1]
+            change = (values[idx] / prev - 1.0) * 100.0 if prev else float("nan")
+            _flag(
+                idx,
+                "RateOfChange",
+                f"{change:+.1f}% vs previous point {prev:.6g}",
+            )
+
+    # 2) one-sided drift vs the running mean (3 sigma)
+    if direction == "down":
+        online = OnlineNormalStrategy(
+            lower_deviation_factor=3.0, upper_deviation_factor=None
+        )
+    else:
+        online = OnlineNormalStrategy(
+            lower_deviation_factor=None, upper_deviation_factor=3.0
+        )
+    for idx, anomaly in online.detect(values, (0, n)):
+        _flag(idx, "OnlineNormal", anomaly.detail or ">3 sigma vs running mean")
+
+    # 3) seasonal forecast residuals, only with enough history for two
+    # full (weekly) cycles of training plus a test window
+    if n >= HW_MIN_POINTS:
+        hw = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+        try:
+            for idx, anomaly in hw.detect(values, (14, n)):
+                _flag(idx, "HoltWinters", anomaly.detail or "forecast residual")
+        except (ValueError, ImportError):
+            pass  # degenerate series / missing scipy: skip the seasonal pass
+
+    return [flagged[idx] for idx in sorted(flagged)]
+
+
+def _repo_series(
+    repo_path: str,
+) -> List[Tuple[str, str, List[Any]]]:
+    """(series_name, direction, points) triples from a repository file."""
+    _ensure_repo_on_path()
+    from deequ_tpu.anomaly import DataPoint
+    from deequ_tpu.repository import engine
+    from deequ_tpu.repository.fs import FileSystemMetricsRepository
+
+    if not os.path.exists(repo_path):
+        return []
+    repository = FileSystemMetricsRepository(repo_path)
+    available = set(engine.engine_metric_names(repository))
+    out: List[Tuple[str, str, List[Any]]] = []
+    for name, direction in WATCHED_SERIES:
+        if name in available:
+            out.append((name, direction, engine.engine_series(repository, name)))
+
+    # phase shares: join phase seconds against wall seconds by timestamp
+    wall = {p.time: p.metric_value for p in engine.engine_series(repository, "engine.wall_s")}
+    for phase in WATCHED_PHASE_SHARES:
+        name = f"engine.phase.{phase}_s"
+        if name not in available:
+            continue
+        shares = [
+            DataPoint(p.time, float(p.metric_value) / float(wall[p.time]))
+            for p in engine.engine_series(repository, name)
+            if p.metric_value is not None and wall.get(p.time)
+        ]
+        if shares:
+            out.append((f"engine.phase_share.{phase}", "up", shares))
+    return out
+
+
+def _bench_series(pattern: str) -> List[Any]:
+    """Headline throughput series from committed BENCH_r0*.json rounds."""
+    _ensure_repo_on_path()
+    from deequ_tpu.anomaly import DataPoint
+
+    points = []
+    for path in sorted(glob.glob(pattern)):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        parsed = data.get("parsed") or {}
+        value = parsed.get("value")
+        round_n = data.get("n")
+        if value is None or round_n is None:
+            continue  # early rounds have "parsed": null
+        points.append(DataPoint(int(round_n), float(value)))
+    points.sort(key=lambda p: p.time)
+    return points
+
+
+def run_sentinel(
+    repo_path: str,
+    bench_pattern: str,
+    *,
+    max_drop: float = 0.2,
+    out=sys.stdout,
+) -> int:
+    """Check every watched series; print the verdict; return exit status."""
+    findings_total = 0
+    checked = 0
+
+    def _report(source: str, name: str, points: Sequence[Any], direction: str) -> None:
+        nonlocal findings_total, checked
+        live = [p for p in points if p.metric_value is not None]
+        if len(live) < MIN_POINTS:
+            out.write(
+                f"sentinel: {name} — {len(live)} points from {source} "
+                f"(need {MIN_POINTS}) — skipped\n"
+            )
+            return
+        checked += 1
+        findings = detect_regressions(live, direction=direction, max_drop=max_drop)
+        if not findings:
+            out.write(f"sentinel: {name} — {len(live)} points from {source} — ok\n")
+            return
+        findings_total += len(findings)
+        out.write(f"sentinel: {name} — {len(live)} points from {source}:\n")
+        for f in findings:
+            out.write(
+                f"  REGRESSION at t={f['time']}: value {f['value']:.6g} "
+                f"({f['detail']}) [{', '.join(f['strategies'])}]\n"
+            )
+
+    for name, direction, points in _repo_series(repo_path):
+        _report(os.path.basename(repo_path), name, points, direction)
+    bench_points = _bench_series(bench_pattern)
+    if bench_points:
+        _report(
+            os.path.basename(bench_pattern), "bench.rows_per_s", bench_points, "down"
+        )
+
+    if findings_total:
+        out.write(
+            f"verdict: REGRESSION — {findings_total} flagged point(s) "
+            f"across {checked} series\n"
+        )
+        return 1
+    if not checked:
+        out.write("verdict: ok — not enough engine history to judge yet\n")
+        return 0
+    out.write(f"verdict: ok — no regressions across {checked} series\n")
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--repo",
+        default=os.path.join(REPO_ROOT, "ENGINE_METRICS.json"),
+        help="metrics repository JSON file with engine telemetry series",
+    )
+    parser.add_argument(
+        "--bench",
+        default=os.path.join(REPO_ROOT, "BENCH_r0*.json"),
+        help="glob of committed bench round files",
+    )
+    parser.add_argument(
+        "--max-drop",
+        type=float,
+        default=0.2,
+        help="relative throughput drop between points that flags (default 0.2)",
+    )
+    args = parser.parse_args(argv)
+    return run_sentinel(args.repo, args.bench, max_drop=args.max_drop)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
