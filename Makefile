@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint typecheck analyze sentinel test test-fast trace-demo
+.PHONY: lint typecheck analyze sentinel test test-fast trace-demo bench-pushdown
 
 lint:
 	$(PY) tools/lint.py
@@ -31,6 +31,13 @@ sentinel:
 
 trace-demo:
 	JAX_PLATFORMS=cpu PYTHONPATH=.:examples $(PY) examples/tracing_example.py
+
+# row-group pushdown A/B over a sorted-key parquet file: same
+# where-heavy plan with DEEQU_TPU_PUSHDOWN=0 then =1, bit-identity
+# asserted, skipped-group counts from the traced pass. Refreshes
+# BENCH_PUSHDOWN.json (methodology: BENCH.md round 8)
+bench-pushdown:
+	JAX_PLATFORMS=cpu BENCH_MODE=pushdown $(PY) bench.py
 
 test: lint
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q

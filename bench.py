@@ -18,12 +18,18 @@ our rows/s divided by that proxy; the build target is >=10.
 Knobs (env):
     BENCH_ROWS      rows to profile           (default 10_000_000)
     BENCH_MODE      "profiler" | "scan" | "stream" | "wide" | "lineitem"
-                    (default "profiler")
+                    | "pushdown" (default "profiler")
                     stream = full profile over an on-disk Parquet file via
                     Table.scan_parquet (out-of-core; constant host memory)
                     wide = the BASELINE.json 50-column north-star shape;
                     lineitem = 16-column TPC-H lineitem-like (both use a
                     best-of-3 measured SAME-SHAPE pandas denominator)
+                    pushdown = row-group pruning A/B (BENCH_PUSHDOWN.json
+                    methodology, BENCH.md round 8): the same where-heavy
+                    fused scan over a sorted-key Parquet file with
+                    DEEQU_TPU_PUSHDOWN=0 then =1, page cache dropped
+                    before each timed pass; skipped-group counts come
+                    from a traced pass. Refreshes BENCH_PUSHDOWN.json
     BENCH_TIMED     timed repetitions, best-of (default 5: shared-vCPU
                      boxes show 20-30% run-to-run noise; best-of-5 reads
                      the machine's actual capability. Compile happens
@@ -313,6 +319,239 @@ def run_scan(table):
     for r in results:
         r.state_or_raise()
     return results
+
+
+PUSHDOWN_SELECTIVITY = 0.1  # fraction of the key range the where keeps
+
+
+def pushdown_where(n_rows: int) -> str:
+    """The selective filter every pushdown-mode member carries: k is
+    globally sorted on disk, so row-group min/max windows prove ~90% of
+    the groups all-false before any Arrow decode."""
+    return f"k < {int(n_rows * PUSHDOWN_SELECTIVITY)}"
+
+
+def pushdown_analyzers(n_rows: int):
+    """The where-heavy plan for BENCH_MODE=pushdown (BENCH.md round 8):
+    every member carries the SAME selective predicate — the row-group
+    pruner only skips a group when every fused member filters it, so a
+    single unfiltered member would silently disable the A/B."""
+    from deequ_tpu.analyzers import (
+        Completeness,
+        Compliance,
+        Maximum,
+        Mean,
+        Minimum,
+        Size,
+        StandardDeviation,
+        Sum,
+    )
+
+    w = pushdown_where(n_rows)
+    return [
+        Size(where=w),
+        Completeness("v", where=w),
+        Mean("v", where=w),
+        Minimum("v", where=w),
+        Maximum("v", where=w),
+        Sum("v", where=w),
+        StandardDeviation("v", where=w),
+        Compliance("v above -200", "v >= -200", where=w),
+    ]
+
+
+def write_pushdown_parquet(
+    n_rows: int,
+    path: str,
+    chunk: int = 2_000_000,
+    row_group_size: int = 250_000,
+) -> None:
+    """Sorted-key Parquet for the pushdown A/B: k is globally sorted so
+    row-group min/max are disjoint windows (maximally prunable); v
+    carries 2% NaN so the DOUBLE null-bound soundness rules run on the
+    hot path; s is a low-cardinality string column the stats never
+    judge."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    writer = None
+    done = 0
+    while done < n_rows:
+        rows = min(chunk, n_rows - done)
+        rng = np.random.default_rng(done)
+        v = rng.normal(0.0, 50.0, rows)
+        v[rng.random(rows) < 0.02] = np.nan
+        at = pa.table(
+            {
+                "k": np.arange(done, done + rows, dtype=np.int64),
+                "v": v,
+                "s": pa.array(
+                    CATEGORIES[rng.integers(0, len(CATEGORIES), rows)],
+                    type=pa.string(),
+                ),
+            }
+        )
+        if writer is None:
+            writer = pq.ParquetWriter(path, at.schema)
+        writer.write_table(at, row_group_size=row_group_size)
+        done += rows
+    if writer is not None:
+        writer.close()
+
+
+def run_pushdown_bench(n_rows: int) -> None:
+    """BENCH_MODE=pushdown: A/B the static row-group pruner
+    (deequ_tpu.lint.pushdown) on a where-heavy fused scan over a
+    sorted-key Parquet file. Same discipline as the pipeline A/B: a
+    traced warm-up pass first (jit + imports; its prune spans carry the
+    observed skipped-group counts), one traced pass per side for decode
+    self-seconds (tracing is a thumb on the scale, so traced passes are
+    never the timed ones), then two warm-jit cold-IO UNTRACED timed
+    passes with DEEQU_TPU_PUSHDOWN=0 / =1, the page cache dropped
+    before each. The run aborts if the two sides' metrics differ — a
+    speedup that changes a result is worthless. Refreshes
+    BENCH_PUSHDOWN.json next to this file (round/config preserved)."""
+    import pyarrow.parquet as pq
+
+    from deequ_tpu import observe
+    from deequ_tpu.data.table import Table
+    from deequ_tpu.ops.fused import FusedScanPass
+
+    path = os.environ.get("BENCH_PARQUET", "/tmp/bench_pushdown.parquet")
+    t_gen = time.perf_counter()
+    if not (
+        os.path.exists(path) and pq.ParquetFile(path).metadata.num_rows == n_rows
+    ):
+        write_pushdown_parquet(n_rows, path)
+    gen_s = time.perf_counter() - t_gen
+
+    analyzers = pushdown_analyzers(n_rows)
+
+    def run_once():
+        table = Table.scan_parquet(path)
+        snapshot = {}
+        for r in FusedScanPass(analyzers).run(table):
+            value = r.analyzer.compute_metric_from(r.state_or_raise()).value
+            v = (
+                value.get()
+                if value.is_success
+                else type(value.exception).__name__
+            )
+            if isinstance(v, float) and v != v:
+                v = "nan"  # nan != nan would defeat the A/B comparison
+            snapshot[repr(r.analyzer)] = v
+        return snapshot
+
+    # warm-up FIRST (traced, pushdown ON): compiles every program, pays
+    # the one-time imports, and its prune spans carry the observed
+    # skipped-group counts
+    os.environ["DEEQU_TPU_PUSHDOWN"] = "1"
+    with observe.tracing() as tracer_warm:
+        warm_snapshot = run_once()
+    prune = {
+        "groups_total": 0,
+        "groups_skipped": 0,
+        "rows_skipped": 0,
+        "wheres_elided": 0,
+    }
+
+    def visit(span):
+        if span.name == "prune":
+            for key in prune:
+                prune[key] += int(span.attrs.get(key, 0))
+        for child in span.children:
+            visit(child)
+
+    for root in tracer_warm.roots:
+        visit(root)
+
+    # decode self-seconds per side from one traced pass each. The
+    # warm-up above is NOT used for this: it pays cold imports and
+    # file-cache misses, which would inflate the on side's decode time.
+    # Both of these traced passes run warm (jit and page cache), so the
+    # decode delta isolates the decode work pruning removed.
+    os.environ["DEEQU_TPU_PUSHDOWN"] = "0"
+    with observe.tracing() as tracer_off:
+        run_once()
+    os.environ["DEEQU_TPU_PUSHDOWN"] = "1"
+    with observe.tracing() as tracer_on:
+        run_once()
+
+    def decode_busy_s(roots) -> float:
+        return next(
+            (
+                row["busy_s"]
+                for row in observe.pipeline_occupancy(roots)
+                if row["stage"] == "decode"
+            ),
+            0.0,
+        )
+
+    os.environ["DEEQU_TPU_PUSHDOWN"] = "0"
+    cache_dropped = _drop_page_cache()
+    t0 = time.perf_counter()
+    off_snapshot = run_once()
+    off_s = time.perf_counter() - t0
+
+    os.environ["DEEQU_TPU_PUSHDOWN"] = "1"
+    _drop_page_cache()
+    t0 = time.perf_counter()
+    on_snapshot = run_once()
+    on_s = time.perf_counter() - t0
+
+    if off_snapshot != on_snapshot or warm_snapshot != on_snapshot:
+        raise SystemExit(
+            "pushdown A/B: metric mismatch between the pruned and "
+            f"unpruned sides\noff: {off_snapshot}\non:  {on_snapshot}"
+        )
+
+    rec = {
+        "metric": "pushdown_rows_per_sec_per_chip",
+        "value": round(n_rows / on_s, 1),
+        "unit": "rows/s",
+        "rows": n_rows,
+        "where": pushdown_where(n_rows),
+        "pushdown_ab": {
+            "off_s": round(off_s, 2),
+            "on_s": round(on_s, 2),
+            "speedup_pct": round(100.0 * (off_s - on_s) / off_s, 1),
+            "decode_s_off": round(decode_busy_s(tracer_off.roots), 2),
+            "decode_s_on": round(decode_busy_s(tracer_on.roots), 2),
+            "rg_total": prune["groups_total"],
+            "rg_skipped": prune["groups_skipped"],
+            "rows_skipped": prune["rows_skipped"],
+            "wheres_elided": prune["wheres_elided"],
+            "bit_identical": True,
+            "page_cache_dropped": cache_dropped,
+            "passes": (
+                "traced warm-up (on) for prune counts + one traced pass "
+                "per side for decode self-seconds; both timed passes are "
+                "warm-jit, cold-IO, untraced"
+            ),
+        },
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "BENCH_PUSHDOWN.json")
+    try:
+        with open(out_path) as fh:
+            old = json.load(fh)
+        for key in ("round", "config"):
+            if key in old and key not in rec:
+                rec[key] = old[key]
+    except Exception:  # noqa: BLE001 - first write: no fields to carry
+        pass
+    with open(out_path, "w") as fh:
+        json.dump(rec, fh)
+        fh.write("\n")
+    print(
+        f"# bench: pushdown A/B off={off_s:.2f}s on={on_s:.2f}s "
+        f"(+{100.0 * (off_s - on_s) / off_s:.1f}%), "
+        f"rg {prune['groups_skipped']}/{prune['groups_total']} skipped, "
+        f"decode {rec['pushdown_ab']['decode_s_off']:.2f}s -> "
+        f"{rec['pushdown_ab']['decode_s_on']:.2f}s; gen={gen_s:.1f}s",
+        file=sys.stderr,
+    )
+    print(json.dumps(rec))
 
 
 def _stream_shape() -> str:
@@ -643,6 +882,12 @@ def main() -> None:
     if trace_enabled:
         # shape-regression subprocesses inherit the flag through env
         os.environ["BENCH_TRACE"] = "1"
+
+    if mode == "pushdown":
+        # self-contained A/B with its own JSON record and artifact;
+        # none of the baseline machinery below applies
+        run_pushdown_bench(n_rows)
+        return
 
     t_gen = time.perf_counter()
     if mode == "stream":

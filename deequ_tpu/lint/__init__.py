@@ -28,6 +28,14 @@ from deequ_tpu.lint.explain import (
     render_explain,
 )
 from deequ_tpu.lint.fold import const_fold, fold_to_constant, satisfiability
+from deequ_tpu.lint.interval import Interval
+from deequ_tpu.lint.pushdown import (
+    ColumnStats,
+    PredicatePrune,
+    PrunePlan,
+    RowGroupStats,
+    build_prune_plan,
+)
 from deequ_tpu.lint.planlint import (
     lint_analyzer,
     lint_expression_use,
@@ -56,11 +64,17 @@ __all__ = [
     "lint_plan",
     "validate_plan",
     "AnalyzerEffect",
+    "ColumnStats",
     "ExplainResult",
     "FamilyGroupCost",
+    "Interval",
     "PassCost",
     "PlanCost",
+    "PredicatePrune",
+    "PrunePlan",
+    "RowGroupStats",
     "analyze_plan",
+    "build_prune_plan",
     "cost_diagnostics",
     "explain",
     "explain_plan",

@@ -95,6 +95,13 @@ class PassCost:
     #: `pack_batch_inputs` layout math); None when the key set contains
     #: a data-dependent format (e.g. range-narrowed int codes)
     wire_bytes_per_batch: Optional[int] = None
+    #: row-group pushdown prediction (scan passes over parquet sources
+    #: with statistics only): groups in the file / groups the runtime
+    #: will skip / decode bytes those skipped groups would have cost.
+    #: None = no statistics were available to the planner.
+    rg_total: Optional[int] = None
+    rg_skipped: Optional[int] = None
+    saved_read_bytes: Optional[float] = None
     family_groups: Tuple[FamilyGroupCost, ...] = ()
     #: grouping passes: estimated distinct-group count (product of
     #: `approx_distinct` hints); None when any hint is missing
@@ -168,6 +175,10 @@ class PlanCost:
     #: stream-pipeline prediction for the scan pass; None for
     #: non-streaming plans (in-memory tables never engage the pipeline)
     pipeline: Optional[PipelineCost] = None
+    #: the full lint/pushdown.PrunePlan behind the scan pass's rg_*
+    #: fields (per-predicate verdicts + eligibility for DQ310/DQ311);
+    #: None when no row-group statistics reached the planner
+    prune: Optional[Any] = None
 
     @property
     def total_read_bytes_per_row(self) -> float:
@@ -250,6 +261,10 @@ def cost_drift(cost: "PlanCost", trace: Any) -> Dict[str, float]:
             out["drift.wire_bytes_first_batch"] = float(
                 first_wire - scan.wire_bytes_per_batch
             )
+        if scan.rg_skipped is not None and "rg_total" in trace.counters:
+            out["drift.rg_skipped"] = float(
+                int(trace.counters.get("rg_skipped", 0)) - scan.rg_skipped
+            )
     return out
 
 
@@ -262,17 +277,21 @@ def _predict_packed_bytes(
     rows: int,
     batch_size: int,
     compute_itemsize: int,
+    elided: frozenset = frozenset(),
 ) -> Optional[int]:
     """Replay `pack_batch_inputs` byte accounting for one batch of
     `rows` rows. Returns None when a key's wire format is data-dependent
-    (runtime range-narrowing) and therefore not statically exact."""
+    (runtime range-narrowing) and therefore not statically exact.
+    `elided` holds where-keys the pushdown analyzer proved all-true on
+    every decoded group: the runtime swaps them for constant masks, so
+    they cost scalar bookkeeping, not mask bytes."""
     from deequ_tpu.ops.fused import _pad_size
 
     padded = _pad_size(rows, batch_size)
     total = 0
     any_const = False
     for key in device_keys:
-        if key == "where:<all>":
+        if key == "where:<all>" or key in elided:
             any_const = True
         elif key.startswith("valid:"):
             fld = schema.field(key[len("valid:") :])
@@ -330,6 +349,7 @@ def analyze_plan(
     stream_batch_rows: Optional[int] = None,
     link_bandwidth: Optional[float] = None,
     pipeline_depth: Optional[int] = None,
+    row_groups: Optional[Sequence[Any]] = None,
 ) -> PlanCost:
     """Abstract interpretation of `AnalysisRunner._do_analysis_run`:
     dedupe -> static precondition filtering (zero-row table) ->
@@ -343,7 +363,14 @@ def analyze_plan(
     `stream_batch_rows` is the source's own per-batch row cap
     (`ParquetSource.batch_rows`): a streamed source yields batches of
     `min(batch_size, batch_rows)` rows, so the batch count and per-batch
-    wire bytes must honor it to stay trace-exact."""
+    wire bytes must honor it to stay trace-exact.
+
+    `row_groups` (a `lint/pushdown.RowGroupStats` sequence, from
+    `ParquetSource.row_group_stats()`) switches the scan pass onto the
+    pushdown model: batch count and first-batch rows come from an exact
+    replay of the source's row-group iteration over the groups the
+    runtime will actually decode, and the pass reports predicted
+    skipped/decoded groups + saved read bytes."""
     from deequ_tpu.analyzers.base import Preconditions, ScanShareableAnalyzer
     from deequ_tpu.analyzers.frequency import (
         FrequencyBasedAnalyzer,
@@ -434,6 +461,38 @@ def analyze_plan(
             per_batch = min(per_batch, int(stream_batch_rows))
         batches = _n_batches(num_rows, per_batch)
 
+        # ---- row-group pushdown (parquet statistics available) ----------
+        # Mirrors the runtime decision point exactly: FusedScanPass.run
+        # prunes with the wheres of the LIVE members (spec errors are
+        # already out), gated on the same knob this prediction reads.
+        prune_plan = None
+        pushdown_on = runtime.pushdown_enabled()
+        batch_rows_list: Optional[Tuple[int, ...]] = None
+        if row_groups and streaming and plan.any_members:
+            from deequ_tpu.lint.pushdown import build_prune_plan, types_from_schema
+
+            live_idx = (
+                plan.merge_idx + plan.assisted_idx
+                + plan.host_idx + plan.host_assisted_idx
+            )
+            try:
+                prune_plan = build_prune_plan(
+                    [getattr(shareable[i], "where", None) for i in live_idx],
+                    row_groups,
+                    types_from_schema(schema),
+                )
+            except Exception:  # noqa: BLE001 — prediction only, never fatal
+                prune_plan = None
+        if prune_plan is not None:
+            cost.prune = prune_plan
+            batch_rows_list = prune_plan.predicted_batch_rows(
+                per_batch, pruned=pushdown_on
+            )
+            # the decode replay is exact even without any skip: it
+            # models the source's tiny-group coalescing, which plain
+            # ceil(num_rows / per_batch) cannot
+            batches = max(1, len(batch_rows_list))
+
         device_keys = sorted(plan.device_keys)
         scan_columns: List[str] = []
         for eff in effects:
@@ -462,9 +521,17 @@ def analyze_plan(
         first_rows = (
             min(num_rows, per_batch) if num_rows is not None else per_batch
         )
+        elided_keys: frozenset = frozenset()
+        if batch_rows_list is not None:
+            first_rows = batch_rows_list[0] if batch_rows_list else 0
+        if prune_plan is not None and pushdown_on:
+            elided_keys = frozenset(
+                f"where:{text}" for text in prune_plan.elided_wheres()
+            )
         wire_exact = (
             _predict_packed_bytes(
-                device_keys, schema, first_rows, eff_batch, itemsize
+                device_keys, schema, first_rows, eff_batch, itemsize,
+                elided=elided_keys,
             )
             if use_device
             else 0
@@ -492,6 +559,16 @@ def analyze_plan(
             family_groups=family_groups,
             notes=tuple(notes),
         )
+        if prune_plan is not None:
+            scan_pass.rg_total = prune_plan.total_groups
+            scan_pass.rg_skipped = (
+                prune_plan.skipped_groups if pushdown_on else 0
+            )
+            scan_pass.saved_read_bytes = (
+                scan_pass.read_bytes_per_row * prune_plan.skipped_rows
+                if pushdown_on
+                else 0.0
+            )
         cost.passes.append(scan_pass)
 
         if streaming:

@@ -46,6 +46,8 @@ CODES = {
     "DQ303": "per-pass working set exceeds the cache-tile budget",
     "DQ304": "transfer-per-row anti-pattern",
     "DQ305": "pipeline queue depth cannot hide the measured transfer latency",
+    "DQ310": "where predicate not pushdown-eligible",
+    "DQ311": "statistics prove every row group skippable",
 }
 
 

@@ -213,6 +213,42 @@ def cost_diagnostics(
                 "a transfer)",
             )
         )
+
+    # DQ310/DQ311 — row-group pushdown (lint/pushdown.py). DQ310: a
+    # where filter the interpreter cannot reason about, anchored on the
+    # offending subexpression; DQ311: the statistics prove every group
+    # skippable — a scan that decodes nothing almost always means a
+    # misconfigured suite (wrong column, impossible range, stale file)
+    prune = cost.prune
+    if prune is not None:
+        for p in prune.predicates:
+            if not p.eligible:
+                diags.append(
+                    Diagnostic(
+                        "DQ310",
+                        Severity.WARNING,
+                        f"where filter {p.where!r} is not pushdown-"
+                        f"eligible ({p.reason}): every row group decodes "
+                        "and filters at runtime even when statistics "
+                        "could have excluded it",
+                        source=p.where,
+                        span=p.span,
+                    )
+                )
+        if prune.proven_empty:
+            diags.append(
+                Diagnostic(
+                    "DQ311",
+                    Severity.WARNING,
+                    "row-group statistics prove every where filter FALSE "
+                    f"on all {prune.total_groups} row group(s): every "
+                    "filtered metric is empty (one sentinel group still "
+                    "decodes to keep results identical to an unpruned "
+                    "scan) — check the predicates against the data's "
+                    "actual ranges (wrong column, impossible range, or a "
+                    "stale file)",
+                )
+            )
     return diags
 
 
@@ -246,6 +282,13 @@ def _render_pass(p: PassCost, idx: int) -> List[str]:
         lines.append(f"  batches: {p.n_batches}"
                      + (f", first-batch wire {_fmt_bytes(p.wire_bytes_per_batch)}"
                         if p.wire_bytes_per_batch is not None else ""))
+        if p.rg_total is not None and p.rg_skipped is not None:
+            lines.append(
+                f"  row groups: {p.rg_total - p.rg_skipped} decoded, "
+                f"{p.rg_skipped} skipped statically"
+                + (f" (saves ~{_fmt_bytes(p.saved_read_bytes)} decode)"
+                   if p.saved_read_bytes else "")
+            )
         for g in p.family_groups:
             tag = "batched" if g.batched else "solo"
             lines.append(
@@ -374,6 +417,7 @@ def explain_plan(
     stream_batch_rows: Optional[int] = None,
     link_bandwidth: Optional[float] = None,
     pipeline_depth: Optional[int] = None,
+    row_groups: Optional[Sequence] = None,
 ) -> ExplainResult:
     """EXPLAIN an analysis plan against a `Table` (schema and row count
     are taken from it — still zero data scanned) or a `SchemaInfo`.
@@ -382,7 +426,13 @@ def explain_plan(
     bare `SchemaInfo`), and `stream_batch_rows` to the table's own
     per-batch row cap; streaming plans additionally predict the stream
     pipeline's overlap shape and the DQ305 queue-depth lint, with the
-    link bandwidth from `link_bandwidth` or the cached placement probe."""
+    link bandwidth from `link_bandwidth` or the cached placement probe.
+
+    `row_groups` defaults to the source's own parquet statistics
+    (`row_group_stats()`) when it exposes them — reading file metadata,
+    never a row — which turns on the pushdown prediction: skipped vs
+    decoded row groups, the exact decode batch replay, and the
+    DQ310/DQ311 lints."""
     if isinstance(data_or_schema, SchemaInfo):
         schema = data_or_schema
     else:
@@ -394,6 +444,13 @@ def explain_plan(
         if stream_batch_rows is None and streaming:
             cap = getattr(data_or_schema, "batch_rows", None)
             stream_batch_rows = int(cap) if cap else None
+        if row_groups is None:
+            stats_fn = getattr(data_or_schema, "row_group_stats", None)
+            if stats_fn is not None:
+                try:
+                    row_groups = stats_fn()
+                except Exception:  # noqa: BLE001 — stats are advisory
+                    row_groups = None
     plan = _plan_analyzers(analyzers, checks)
     cost = analyze_plan(
         plan,
@@ -408,6 +465,7 @@ def explain_plan(
         stream_batch_rows=stream_batch_rows,
         link_bandwidth=link_bandwidth,
         pipeline_depth=pipeline_depth,
+        row_groups=row_groups,
     )
     return ExplainResult(
         cost=cost, diagnostics=cost_diagnostics(cost, plan, schema)

@@ -32,6 +32,7 @@ from deequ_tpu.data.expr import (
     Node,
     Un,
 )
+from deequ_tpu.lint.interval import Interval
 from deequ_tpu.lint.schema import SchemaInfo
 
 _DNF_BRANCH_CAP = 64
@@ -253,13 +254,14 @@ def _dnf(node: Node, neg: bool) -> Optional[List[Branch]]:
 
 
 class _ColFacts:
-    __slots__ = ("lo", "lo_strict", "hi", "hi_strict", "eq", "ne", "domain")
+    """Per-column conjunction state: one Interval element (the shared
+    lattice in lint/interval.py, also the pushdown interpreter's domain)
+    plus eq/ne point facts the interval form can't express."""
+
+    __slots__ = ("iv", "eq", "ne", "domain")
 
     def __init__(self):
-        self.lo = -math.inf
-        self.lo_strict = False
-        self.hi = math.inf
-        self.hi_strict = False
+        self.iv = Interval.top()
         self.eq: object = _UNSET
         self.ne: set = set()
         self.domain: Optional[str] = None  # 'num' | 'str' once constrained
@@ -331,29 +333,18 @@ def _branch_verdict(
                 if f.eq is not _UNSET and f.eq == v:
                     return "unsat", False
                 f.ne.add(v)
-            elif op in ("ge", "gt"):
-                strict = op == "gt"
-                if v > f.lo or (v == f.lo and strict and not f.lo_strict):
-                    f.lo, f.lo_strict = v, strict
-            elif op in ("le", "lt"):
-                strict = op == "lt"
-                if v < f.hi or (v == f.hi and strict and not f.hi_strict):
-                    f.hi, f.hi_strict = v, strict
+            elif op in ("ge", "gt", "le", "lt"):
+                f.iv = f.iv.narrow(op, v)
 
     for col, f in facts.items():
         if f.domain != "num":
             continue
-        if f.lo > f.hi:
-            return "unsat", False
-        if f.lo == f.hi and (f.lo_strict or f.hi_strict):
+        if f.iv.is_empty:
             return "unsat", False
         if f.eq is not _UNSET:
-            v = f.eq
-            if v < f.lo or (v == f.lo and f.lo_strict):
+            if not f.iv.contains_point(f.eq):
                 return "unsat", False
-            if v > f.hi or (v == f.hi and f.hi_strict):
-                return "unsat", False
-        elif f.lo == f.hi and f.lo in f.ne:
+        elif f.iv.is_point and f.iv.lo in f.ne:
             return "unsat", False
 
     # check for a must-null column that schema forbids was handled inline
@@ -391,6 +382,19 @@ def satisfiability(node: Node, schema: Optional[SchemaInfo] = None) -> str:
     if sat_plain == 0 and sat_escape == 0:
         return "unknown"
     return "sat"
+
+
+def dnf_branches(node: Node) -> Optional[List[Branch]]:
+    """Public DNF entry shared with the row-group pruning interpreter
+    (lint/pushdown.py): branches of `node` un-negated; None when the
+    expansion exceeds _DNF_BRANCH_CAP."""
+    return _dnf(node, neg=False)
+
+
+def cmp_atom(node: Bin) -> Optional[Atom]:
+    """Public alias of the column-vs-literal atom extractor, used by the
+    pushdown eligibility walk to classify comparison nodes."""
+    return _cmp_atom(node)
 
 
 def fold_to_constant(node: Node) -> Optional[Tuple[bool, object]]:

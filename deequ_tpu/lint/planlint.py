@@ -386,6 +386,7 @@ def validate_plan(
     batch_size: Optional[int] = None,
     streaming: bool = False,
     stream_batch_rows: Optional[int] = None,
+    row_groups: Optional[Sequence] = None,
 ) -> LintReport:
     """Run the full static pass: semantic lints (DQ1xx/DQ2xx) plus the
     cost analyzer's performance lints (DQ3xx, lint/explain.py). The
@@ -410,6 +411,7 @@ def validate_plan(
             batch_size=batch_size,
             streaming=streaming,
             stream_batch_rows=stream_batch_rows,
+            row_groups=row_groups,
         )
         report.extend(cost_diagnostics(report.plan_cost, plan, schema))
     except Exception:  # noqa: BLE001 — cost lint must never break a run

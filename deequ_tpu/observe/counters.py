@@ -71,3 +71,15 @@ def record_group_pass(label: str) -> None:
     tracer = spans.current_tracer()
     if tracer is not None:
         tracer.count("group_passes", 1, f"group:{label}")
+
+
+def record_pruned_groups(skipped: int, total: int) -> None:
+    """Row-group pushdown outcome of one fused scan: groups statically
+    skipped vs groups in the file. Tracer-only (no ExecutionStats
+    field — pruning is an IO property, not an execution count); the
+    counters feed cost_drift's predicted-vs-observed check and the
+    `engine.rg_skipped_ratio` telemetry series."""
+    tracer = spans.current_tracer()
+    if tracer is not None:
+        tracer.count("rg_skipped", int(skipped))
+        tracer.count("rg_total", int(total))

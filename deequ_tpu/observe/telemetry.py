@@ -146,6 +146,15 @@ def engine_metric_record(
         if isinstance(value, (int, float)):
             rec[f"engine.counter.{key}"] = float(value)
 
+    # derived: fraction of parquet row groups the pushdown analyzer
+    # skipped this run (the sentinel watches it for prune-effectiveness
+    # regressions); only present when a prune decision actually ran
+    rg_total = rec.get("engine.counter.rg_total", 0.0)
+    if rg_total > 0.0:
+        rec["engine.rg_skipped_ratio"] = (
+            rec.get("engine.counter.rg_skipped", 0.0) / rg_total
+        )
+
     # satellite: traced_run stamps these on the root span; live /proc read
     # covers traces produced before the attributes existed.
     res = proc_resources()

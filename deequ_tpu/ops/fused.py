@@ -501,6 +501,68 @@ def prune_table_columns(table, specs: Dict[str, Any]):
     return with_columns(sorted(needed))
 
 
+def plan_row_group_prune(table, members):
+    """Static row-group pruning for a parquet-backed scan: build a
+    PrunePlan (lint/pushdown.py's three-valued interpreter) from the
+    file's row-group statistics and the live members' where filters.
+    None when the source has no statistics surface, the knob is off, or
+    anything at all goes wrong — pruning is an optimization, never a
+    failure mode. The decision itself is pure: the source is the only
+    statistics reader."""
+    if not runtime.pushdown_enabled():
+        return None
+    stats_fn = getattr(table, "row_group_stats", None)
+    if stats_fn is None or getattr(table, "with_prune", None) is None:
+        return None
+    from deequ_tpu.lint.pushdown import build_prune_plan
+
+    try:
+        groups = stats_fn()
+        if not groups:
+            return None
+        return build_prune_plan(
+            [getattr(m, "where", None) for m in members],
+            groups,
+            dict(table.schema),
+        )
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def apply_prune_plan(table, prune, specs: Dict[str, Any]):
+    """Act on a PrunePlan: swap every proven-all-true where's mask spec
+    for a constant (the filter's columns then fall out of column
+    pruning and the all-true mask elides on the wire), then view the
+    source without its proven-all-false groups. The `prune` span and
+    rg_* counters record what happened for the trace differential
+    against EXPLAIN's prediction."""
+    from deequ_tpu.analyzers.base import InputSpec, _all_true, where_key
+
+    elided = 0
+    for text in prune.elided_wheres():
+        key = where_key(text)
+        if key in specs:
+            specs[key] = InputSpec(
+                key=key,
+                build=lambda t: _all_true(t.num_rows),
+                columns=(),
+            )
+            elided += 1
+    with observe.span(
+        "prune",
+        cat="plan",
+        groups_total=prune.total_groups,
+        groups_skipped=prune.skipped_groups,
+        rows_skipped=prune.skipped_rows,
+        wheres_elided=elided,
+    ):
+        pass
+    runtime.record_pruned_groups(prune.skipped_groups, prune.total_groups)
+    if prune.skip:
+        table = table.with_prune(prune.skip)
+    return table
+
+
 class HostInputs(dict):
     """Per-batch input map for host-folded members. Host-only keys build
     LAZILY on first access: a member that answers from a pre-pass memo
@@ -1101,6 +1163,14 @@ class FusedScanPass:
         host_keys = plan.host_keys
 
         if plan.any_members:
+            live_idx = merge_idx + assisted_idx + host_idx + host_assisted_idx
+            prune = plan_row_group_prune(
+                table, [self.analyzers[i] for i in live_idx]
+            )
+            if prune is not None:
+                # spec elision must precede column pruning so a
+                # constant-mask where's filter columns drop out of decode
+                table = apply_prune_plan(table, prune, specs)
             table = prune_table_columns(table, specs)
             merge_analyzers = [self.analyzers[i] for i in merge_idx]
             assisted = [self.analyzers[i] for i in assisted_idx]

@@ -199,6 +199,22 @@ def pipeline_enabled() -> bool:
     return os.environ.get("DEEQU_TPU_PIPELINE", "") not in ("0", "off")
 
 
+def pushdown_enabled() -> bool:
+    """Whether parquet scans may skip row groups the static pruning
+    interpreter (lint/pushdown.py) proves carry no qualifying row for
+    ANY fused member's where filter, and may swap proven-all-true
+    filters for constant masks.
+
+    `DEEQU_TPU_PUSHDOWN=0` (or `off`) disables both: every group decodes
+    and every filter evaluates, exactly as before the analyzer existed —
+    the baseline the pushdown differential suite compares against.
+    Pruning is a pure decode-skip: folds are where-masked, so results
+    are bit-identical either way."""
+    import os
+
+    return os.environ.get("DEEQU_TPU_PUSHDOWN", "") not in ("0", "off")
+
+
 def pipeline_depth() -> int:
     """Bounded inter-stage queue depth (`DEEQU_TPU_PIPELINE_DEPTH`,
     default 2): at most this many prepped batches — packed wire buffers
@@ -381,6 +397,10 @@ def record_launch() -> None:
 
 def record_group_pass(label: str) -> None:
     _counters.record_group_pass(label)
+
+
+def record_pruned_groups(skipped: int, total: int) -> None:
+    _counters.record_pruned_groups(skipped, total)
 
 
 def pad_to(arr: np.ndarray, size: int) -> np.ndarray:

@@ -202,6 +202,16 @@ class AnalysisRunner:
             schema = SchemaInfo.from_table(data)
             streaming = bool(getattr(data, "is_streaming", False))
             cap = getattr(data, "batch_rows", None) if streaming else None
+            # parquet sources expose row-group statistics: the cost pass
+            # then predicts the pushdown outcome (skipped groups, batch
+            # replay) the runtime will produce, trace-verifiably
+            row_groups = None
+            stats_fn = getattr(data, "row_group_stats", None)
+            if stats_fn is not None:
+                try:
+                    row_groups = stats_fn()
+                except Exception:  # noqa: BLE001 — stats are advisory
+                    row_groups = None
             report = validate_plan(
                 schema,
                 checks=(),
@@ -210,6 +220,7 @@ class AnalysisRunner:
                 num_rows=int(data.num_rows),
                 streaming=streaming,
                 stream_batch_rows=int(cap) if cap else None,
+                row_groups=row_groups,
             )
             return list(report.diagnostics), report.plan_cost
         except PlanValidationError:

@@ -365,6 +365,60 @@ def test_obsprint_rule_scopes_to_observe_dir():
     assert not in_scope(exempt)
 
 
+# -- PUSHDOWN: purity of the stats interpreter (ISSUE 7 satellite) -----------
+
+
+def test_pushdown_checker_flags_pyarrow_import_even_lazy():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def read_stats(path):\n"
+        "    import pyarrow.parquet as pq\n"
+        "    return pq.ParquetFile(path)\n"
+    )
+    try:
+        findings = lint.check_pushdown_purity(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+    assert "PUSHDOWN" in findings[0] and "pyarrow" in findings[0]
+
+
+def test_pushdown_checker_flags_open_call():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def sniff(path):\n"
+        "    with open(path, 'rb') as f:\n"
+        "        return f.read(4)\n"
+    )
+    try:
+        findings = lint.check_pushdown_purity(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+    assert "PUSHDOWN" in findings[0] and "open" in findings[0]
+
+
+def test_pushdown_checker_allows_pure_interpreter_code():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import math\n"
+        "from deequ_tpu.lint.interval import Interval\n"
+        "def verdict(lo, hi):\n"
+        "    return Interval.closed(lo, hi).is_empty or math.isnan(lo)\n"
+    )
+    try:
+        findings = lint.check_pushdown_purity(path)
+    finally:
+        os.unlink(path)
+    assert findings == []
+
+
+def test_pushdown_rule_covers_the_interpreter_file():
+    lint = _lint_module()
+    sep = os.sep
+    assert f"deequ_tpu{sep}lint{sep}pushdown.py" in lint.PUSHDOWN_FILES
+
+
 def test_globalmut_reads_are_not_findings():
     lint = _lint_module()
     path = _tmp_source(

@@ -486,6 +486,131 @@ def _iter_spans(root):
         stack.extend(sp.children)
 
 
+# -- row-group pushdown on/off differential (ISSUE 7) ------------------------
+
+
+def pushdown_table(rng: np.random.Generator) -> Table:
+    """Sorted-key layout: parquet row-group min/max over `k` are disjoint
+    ranges, so comparison wheres are genuinely selective. `v` carries
+    NaN (runtime nulls invisible to parquet stats) and real nulls; `s`
+    is a string column (never stats-decidable)."""
+    n = int(rng.integers(1200, 4000))
+    k = np.sort(rng.integers(0, 10_000, n))
+    v = rng.normal(0.0, 50.0, n)
+    v[rng.random(n) < 0.05] = np.nan
+    v_list = [None if rng.random() < 0.02 else float(x) for x in v]
+    s = np.array(["a", "b", "v1", "zz"], dtype=object)[rng.integers(0, 4, n)]
+    s[rng.random(n) < 0.1] = None
+    return Table.from_pydict(
+        {"k": [int(x) for x in k], "v": v_list, "s": list(s)},
+        types={
+            "k": ColumnType.LONG,
+            "v": ColumnType.DOUBLE,
+            "s": ColumnType.STRING,
+        },
+    )
+
+
+def random_pushdown_where(rng: np.random.Generator) -> str:
+    """Mixed eligibility: selective sorted-key comparisons, NaN-hampered
+    float ranges, stats-opaque string predicates, and/or combinations."""
+    cut = int(rng.integers(-100, 10_100))
+    roll = rng.random()
+    if roll < 0.5:
+        op = str(rng.choice(["<", "<=", ">", ">=", "=", "!="]))
+        return f"k {op} {cut}"
+    if roll < 0.7:
+        return f"k < {cut} and v > {float(rng.uniform(-100, 100)):.1f}"
+    if roll < 0.85:
+        lo = int(rng.integers(0, 2500))
+        hi = int(rng.integers(7500, 10_000))
+        return f"k < {lo} or k > {hi}"
+    return str(rng.choice(["s != 'zz'", "v is not null", f"k >= {cut}"]))
+
+
+def pushdown_check(rng: np.random.Generator, wheres) -> Check:
+    """Every constraint filters (an unfiltered fused member disables all
+    skipping), drawn from scan-shareable, exactly-folded builders —
+    sketch metrics are excluded because pruning changes decode batch
+    boundaries and sketch compaction is partition-sensitive."""
+    frac_t = float(rng.uniform(0, 1))
+    stat_t = float(rng.uniform(-120, 120))
+    builders = [
+        lambda c: c.has_size(lambda v, t=stat_t: v >= t),
+        lambda c: c.has_completeness("v", lambda v, t=frac_t: v >= t),
+        lambda c: c.has_completeness("s", lambda v, t=frac_t: v >= t),
+        lambda c: c.has_mean("v", lambda v, t=stat_t: v >= t),
+        lambda c: c.has_min("v", lambda v, t=stat_t: v <= t),
+        lambda c: c.has_max("k", lambda v, t=stat_t: v >= t),
+        lambda c: c.has_sum("v", lambda v, t=stat_t: v >= t),
+        lambda c: c.has_standard_deviation("v", lambda v, t=frac_t: v >= t),
+        lambda c: c.satisfies("v > 0", "pos", lambda v, t=frac_t: v >= t),
+    ]
+    check = Check(CheckLevel.ERROR, f"pushdown-{rng.integers(1 << 30)}")
+    k = int(rng.integers(3, 8))
+    for i in rng.choice(len(builders), size=k, replace=False):
+        check = builders[int(i)](check).where(str(rng.choice(wheres)))
+    return check
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_pushdown_on_off_bit_identical(seed, monkeypatch, tmp_path):
+    """DEEQU_TPU_PUSHDOWN=0 must be BIT-identical to the pruning path —
+    exact snapshot equality (same engine, same surviving rows, masked
+    folds are exact): statically skipping a row group may never change
+    one bit of any metric. Even seeds share one aggressively selective
+    where across all constraints and assert groups actually skipped;
+    odd seeds draw independent mixed-eligibility wheres (string
+    predicates, NaN floats, or-clauses) where skipping is incidental."""
+    from deequ_tpu import observe
+    from deequ_tpu.data.table import Table as TableCls
+
+    rng = np.random.default_rng(13_000 + seed)
+    table = pushdown_table(rng)
+    n = table.num_rows
+    if seed % 2 == 0:
+        wheres = [f"k < {int(rng.integers(500, 2500))}"]
+    else:
+        wheres = [random_pushdown_where(rng) for _ in range(3)]
+    checks = [
+        pushdown_check(rng, wheres) for _ in range(int(rng.integers(1, 3)))
+    ]
+
+    path = str(tmp_path / "pushdown.parquet")
+    table.to_parquet(
+        path, row_group_size=max(64, n // 7), dictionary_encode_strings=True
+    )
+
+    def run(pushdown_env):
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "device" if seed % 4 >= 2 else "host")
+        monkeypatch.setenv("DEEQU_TPU_PUSHDOWN", pushdown_env)
+        data = TableCls.scan_parquet(path, batch_rows=max(64, n // 5))
+        builder = VerificationSuite().on_data(data)
+        for check in checks:
+            builder = builder.add_check(check)
+        return suite_snapshot(builder.with_engine("single").run())
+
+    off = run("0")
+    on = run("1")
+    assert off == on, (seed, wheres)
+
+    with observe.tracing() as tracer:
+        traced = run("1")
+    assert traced == on, ("tracing changed results", seed)
+    prunes = [
+        sp
+        for root in tracer.roots
+        for sp in _iter_spans(root)
+        if sp.name == "prune"
+    ]
+    assert prunes, "pushdown never produced a prune decision"
+    if seed % 2 == 0:
+        assert sum(sp.attrs["groups_skipped"] for sp in prunes) > 0, (
+            "selective shared where skipped nothing",
+            wheres,
+        )
+
+
 @pytest.mark.parametrize(
     "layout,seed",
     [("wide", 0), ("wide", 1), ("lineitem", 0), ("lineitem", 1)],

@@ -31,6 +31,13 @@ Checks:
              explicit stream write (`sys.stderr.write`) — stdout
              belongs to results (bench.py's one-JSON-line contract) and
              a stray print corrupts any caller parsing it.
+  PUSHDOWN — deequ_tpu/lint/pushdown.py must stay a pure interpreter:
+             no pyarrow/pandas import (not even lazily inside a
+             function) and no `open(...)` call. Statistics reach it as
+             plain RowGroupStats records; ParquetSource.row_group_stats
+             is the single reader. Purity keeps every verdict unit-
+             testable without files and the lint layer importable
+             without pyarrow.
   F401*    — unused imports (fallback when ruff is unavailable).
   E722*    — bare `except:` (fallback when ruff is unavailable).
 
@@ -79,6 +86,9 @@ GLOBALMUT_DIRS = (
 # Dirs where `print(` is banned outright: observability output must go
 # through a sink/callback/stream-write, never stdout.
 OBSPRINT_DIRS = (os.path.join("deequ_tpu", "observe"),)
+# Pure-interpreter files: no pyarrow/pandas imports, no open() calls.
+PUSHDOWN_FILES = [os.path.join("deequ_tpu", "lint", "pushdown.py")]
+PUSHDOWN_FORBIDDEN_MODULES = {"pyarrow", "pandas"}
 GLOBALMUT_MUTATORS = {
     "append",
     "extend",
@@ -225,6 +235,44 @@ def check_observe_prints(path: str) -> List[str]:
         and isinstance(node.func, ast.Name)
         and node.func.id == "print"
     ]
+
+
+# -- PUSHDOWN: purity of the stats interpreter -------------------------------
+
+
+def check_pushdown_purity(path: str) -> List[str]:
+    """Flag pyarrow/pandas imports (top-level or inside any function)
+    and `open(...)` calls in the pushdown interpreter: statistics must
+    arrive as plain RowGroupStats records through
+    ParquetSource.row_group_stats — never read here."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    findings: List[str] = []
+    for node in ast.walk(tree):
+        modules: List[str] = []
+        if isinstance(node, ast.Import):
+            modules = [alias.name for alias in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            modules = [node.module]
+        for mod in modules:
+            if mod.split(".")[0] in PUSHDOWN_FORBIDDEN_MODULES:
+                findings.append(
+                    f"{_rel(path)}:{node.lineno}: PUSHDOWN `{mod}` import "
+                    f"in the stats interpreter — statistics arrive as "
+                    f"RowGroupStats records; the only reader is "
+                    f"ParquetSource.row_group_stats"
+                )
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "open"
+        ):
+            findings.append(
+                f"{_rel(path)}:{node.lineno}: PUSHDOWN `open(...)` in the "
+                f"stats interpreter — it must never touch files; pass "
+                f"RowGroupStats in"
+            )
+    return findings
 
 
 # -- GLOBALMUT: unguarded module-global mutable state ------------------------
@@ -496,6 +544,11 @@ def main() -> int:
         path = os.path.join(REPO, rel)
         if os.path.exists(path):
             findings.extend(check_pipeline_syncs(path))
+
+    for rel in PUSHDOWN_FILES:
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):
+            findings.extend(check_pushdown_purity(path))
 
     for path in _python_files():
         rel = _rel(path)

@@ -45,6 +45,10 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WATCHED_SERIES: Sequence[Tuple[str, str]] = (
     ("engine.rows_per_s", "down"),
     ("engine.peak_rss_mb", "up"),
+    # pushdown effectiveness: the fraction of parquet row groups skipped
+    # statically; a drop means predicates stopped proving groups
+    # all-false (stats regressed, interpreter weakened, plan changed)
+    ("engine.rg_skipped_ratio", "down"),
 )
 
 #: phases whose share of wall time is watched (rises are bad: a phase
