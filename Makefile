@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint typecheck analyze sentinel test test-fast trace-demo bench-pushdown
+.PHONY: lint typecheck analyze sentinel test test-fast trace-demo bench-pushdown bench-decode
 
 lint:
 	$(PY) tools/lint.py
@@ -38,6 +38,15 @@ trace-demo:
 # BENCH_PUSHDOWN.json (methodology: BENCH.md round 8)
 bench-pushdown:
 	JAX_PLATFORMS=cpu BENCH_MODE=pushdown $(PY) bench.py
+
+# decode fast-path A/B over the 50-column wide stream shape: same
+# decode-bound plan with DEEQU_TPU_DECODE_FASTPATH=0 then =1 (plus a
+# worker-pool pass), bit-identity asserted, decode self-seconds from
+# traced passes. Refreshes BENCH_DECODE.json (methodology: BENCH.md
+# round 9)
+BENCH_DECODE_ROWS ?= 4000000
+bench-decode:
+	JAX_PLATFORMS=cpu BENCH_MODE=decode BENCH_ROWS=$(BENCH_DECODE_ROWS) $(PY) bench.py
 
 test: lint
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q

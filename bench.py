@@ -18,7 +18,7 @@ our rows/s divided by that proxy; the build target is >=10.
 Knobs (env):
     BENCH_ROWS      rows to profile           (default 10_000_000)
     BENCH_MODE      "profiler" | "scan" | "stream" | "wide" | "lineitem"
-                    | "pushdown" (default "profiler")
+                    | "pushdown" | "decode" (default "profiler")
                     stream = full profile over an on-disk Parquet file via
                     Table.scan_parquet (out-of-core; constant host memory)
                     wide = the BASELINE.json 50-column north-star shape;
@@ -30,6 +30,13 @@ Knobs (env):
                     DEEQU_TPU_PUSHDOWN=0 then =1, page cache dropped
                     before each timed pass; skipped-group counts come
                     from a traced pass. Refreshes BENCH_PUSHDOWN.json
+                    decode = buffer-level decode fast path A/B
+                    (BENCH_DECODE.json, BENCH.md round 9): a decode-bound
+                    fused scan over the 50-column wide stream shape with
+                    DEEQU_TPU_DECODE_FASTPATH=0 then =1, page cache
+                    dropped before each timed pass; decode self-seconds
+                    come from traced warm passes. Refreshes
+                    BENCH_DECODE.json
     BENCH_TIMED     timed repetitions, best-of (default 5: shared-vCPU
                      boxes show 20-30% run-to-run noise; best-of-5 reads
                      the machine's actual capability. Compile happens
@@ -554,6 +561,284 @@ def run_pushdown_bench(n_rows: int) -> None:
     print(json.dumps(rec))
 
 
+def write_decode_parquet(
+    n_rows: int, path: str, chunk: int = 2_000_000, null_frac: float = 0.03
+) -> None:
+    """The decode-wall shape: the 50-column wide stream mix with ~3%
+    nulls in EVERY column — the reason a data-quality engine scans a
+    table at all. Null-free columns decode near-zero-copy on the host
+    chain already; it is the validity handling (fill_null allocation +
+    mask extraction + NaN fold, one pass each) that builds the decode
+    wall the fast path collapses into a single buffer-level pass."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    writer = None
+    done = 0
+    seed = 0
+    while done < n_rows:
+        rows = min(chunk, n_rows - done)
+        rng = np.random.default_rng(seed)
+
+        def nullify(values):
+            return pa.array(values, mask=rng.random(rows) < null_frac)
+
+        data = {}
+        f00 = rng.lognormal(2.0, 1.0, rows)
+        f00[rng.random(rows) < 0.03] = np.nan  # NaN rides beside nulls
+        data["f00"] = nullify(f00)
+        for i in range(1, 20):
+            r = (200, 1_000, 2_000, 10_000)[i % 4]
+            data[f"f{i:02d}"] = nullify(rng.integers(0, r, rows) / 100.0)
+        for i in range(10):
+            hi = 100 * (i + 1) if i < 6 else 50_000
+            data[f"i{i:02d}"] = nullify(rng.integers(0, hi, rows))
+        for i in range(5):
+            data[f"b{i}"] = nullify(rng.random(rows) < (0.2 + 0.15 * i))
+        for i in range(10):
+            pool = CATEGORIES[: 3 + i]
+            data[f"s{i:02d}"] = nullify(pool[rng.integers(0, len(pool), rows)])
+        for i in range(5):
+            pool = np.array(
+                [str(v) for v in rng.integers(0, 2000 * (i + 1), 4096)],
+                dtype=object,
+            )
+            data[f"c{i}"] = nullify(pool[rng.integers(0, len(pool), rows)])
+        at = pa.table(data)
+        if writer is None:
+            writer = pq.ParquetWriter(path, at.schema)
+        writer.write_table(at)
+        done += rows
+        seed += 1
+    if writer is not None:
+        writer.close()
+
+
+def decode_analyzers():
+    """The decode-bound plan for BENCH_MODE=decode: Completeness over
+    every one of the 50 wide-stream columns plus Mean over the numerics.
+    Every consumer here is packed-wire-safe, so the planner proves the
+    whole schema (floats, ints, bools, dictionary strings) onto the
+    native buffer-level fast path; nothing filters rows, so the scan is
+    pure decode + fold and the A/B isolates the decode wall."""
+    from deequ_tpu.analyzers import Completeness, Mean
+
+    names = (
+        [f"f{i:02d}" for i in range(20)]
+        + [f"i{i:02d}" for i in range(10)]
+        + [f"b{i}" for i in range(5)]
+        + [f"s{i:02d}" for i in range(10)]
+        + [f"c{i}" for i in range(5)]
+    )
+    out = [Completeness(c) for c in names]
+    out += [Mean(f"f{i:02d}") for i in range(20)]
+    out += [Mean(f"i{i:02d}") for i in range(10)]
+    return out
+
+
+def _decode_stage_busy_s(roots) -> float:
+    """Whole decode-stage busy seconds (parquet read + decompression +
+    Arrow->Table) from the prefetch producer's pipe_item spans —
+    context for the A/B, not its headline metric."""
+    from deequ_tpu import observe
+
+    return next(
+        (
+            row["busy_s"]
+            for row in observe.pipeline_occupancy(roots)
+            if row["stage"] == "decode"
+        ),
+        0.0,
+    )
+
+
+def _arrow_decode_self_s(roots) -> float:
+    """Decode self-seconds from a traced pass: the sum of the
+    `arrow_decode` spans (data/source.py), which wrap exactly the
+    Arrow-buffer -> wire conversion the fast path replaces — parquet
+    read/decompression stays outside them on both sides."""
+    total = 0.0
+
+    def visit(span):
+        nonlocal total
+        if span.name == "arrow_decode":
+            total += span.duration_s
+        for child in span.children:
+            visit(child)
+
+    for root in roots:
+        visit(root)
+    return total
+
+
+def run_decode_bench(n_rows: int) -> None:
+    """BENCH_MODE=decode: A/B the buffer-level native decode fast path
+    (deequ_tpu.data.arrow_decode) and the row-group decode worker pool
+    on a decode-bound fused scan over the 50-column wide stream shape.
+    Same discipline as the pushdown A/B: a traced warm-up pass first
+    (jit + imports; its decode_fastpath spans carry the planner's
+    per-column verdicts), then one traced WARM pass per side for decode
+    self-seconds (tracing is a thumb on the scale, so traced passes are
+    never the timed ones), one traced pass at the default worker count,
+    and finally two warm-jit cold-IO UNTRACED timed passes with
+    DEEQU_TPU_DECODE_FASTPATH=0 / =1 at workers=1, the page cache
+    dropped before each. The run aborts if any side's metrics differ —
+    a decode speedup that changes a result is worthless. Refreshes
+    BENCH_DECODE.json next to this file (round/config preserved)."""
+    import pyarrow.parquet as pq
+
+    from deequ_tpu import observe
+    from deequ_tpu.data.table import Table
+    from deequ_tpu.ops.fused import FusedScanPass
+
+    path = os.environ.get("BENCH_PARQUET", "/tmp/bench_decode.parquet")
+    t_gen = time.perf_counter()
+    if not (
+        os.path.exists(path) and pq.ParquetFile(path).metadata.num_rows == n_rows
+    ):
+        write_decode_parquet(n_rows, path)
+    gen_s = time.perf_counter() - t_gen
+
+    analyzers = decode_analyzers()
+
+    def run_once():
+        snapshot = {}
+        for r in FusedScanPass(analyzers).run(
+            Table.scan_parquet(path, batch_rows=1 << 20)
+        ):
+            value = r.analyzer.compute_metric_from(r.state_or_raise()).value
+            v = (
+                value.get()
+                if value.is_success
+                else type(value.exception).__name__
+            )
+            if isinstance(v, float) and v != v:
+                v = "nan"  # nan != nan would defeat the A/B comparison
+            snapshot[repr(r.analyzer)] = v
+        return snapshot
+
+    workers_n = min(os.cpu_count() or 1, 4)
+    os.environ["DEEQU_TPU_DECODE_WORKERS"] = "1"
+
+    # warm-up FIRST (traced, fast path ON): compiles every program, pays
+    # the one-time imports, and its decode_fastpath spans carry the
+    # planner's per-column verdicts
+    os.environ["DEEQU_TPU_DECODE_FASTPATH"] = "1"
+    with observe.tracing() as tracer_warm:
+        warm_snapshot = run_once()
+    plan = {"cols_total": 0, "cols_fast": 0, "cols_fallback": 0}
+
+    def visit(span):
+        if span.name == "decode_fastpath":
+            for key in plan:
+                plan[key] = max(plan[key], int(span.attrs.get(key, 0)))
+        for child in span.children:
+            visit(child)
+
+    for root in tracer_warm.roots:
+        visit(root)
+
+    # decode self-seconds per side from one traced pass each. The
+    # warm-up above is NOT used for this: it pays cold imports and
+    # file-cache misses, which would inflate the on side's decode time.
+    # Both of these traced passes run warm (jit and page cache), so the
+    # decode delta isolates the work the fast path removed.
+    os.environ["DEEQU_TPU_DECODE_FASTPATH"] = "0"
+    with observe.tracing() as tracer_off:
+        run_once()
+    os.environ["DEEQU_TPU_DECODE_FASTPATH"] = "1"
+    with observe.tracing() as tracer_on:
+        run_once()
+    decode_s_off = _arrow_decode_self_s(tracer_off.roots)
+    decode_s_on = _arrow_decode_self_s(tracer_on.roots)
+    stage_s_off = _decode_stage_busy_s(tracer_off.roots)
+    stage_s_on = _decode_stage_busy_s(tracer_on.roots)
+
+    # the worker pool on top of the fast path (traced, warm): on a
+    # single-core box the default collapses to 1 and this re-measures
+    # the on side; on multi-core it shows the pool's overlap
+    os.environ["DEEQU_TPU_DECODE_WORKERS"] = str(workers_n)
+    with observe.tracing() as tracer_pool:
+        pool_snapshot = run_once()
+    decode_s_pool = _arrow_decode_self_s(tracer_pool.roots)
+    os.environ["DEEQU_TPU_DECODE_WORKERS"] = "1"
+
+    os.environ["DEEQU_TPU_DECODE_FASTPATH"] = "0"
+    cache_dropped = _drop_page_cache()
+    t0 = time.perf_counter()
+    off_snapshot = run_once()
+    off_s = time.perf_counter() - t0
+
+    os.environ["DEEQU_TPU_DECODE_FASTPATH"] = "1"
+    _drop_page_cache()
+    t0 = time.perf_counter()
+    on_snapshot = run_once()
+    on_s = time.perf_counter() - t0
+
+    if not (warm_snapshot == off_snapshot == on_snapshot == pool_snapshot):
+        raise SystemExit(
+            "decode A/B: metric mismatch between the fast-path and "
+            f"host-chain sides\noff: {off_snapshot}\non:  {on_snapshot}"
+        )
+
+    reduction = (
+        100.0 * (decode_s_off - decode_s_on) / decode_s_off
+        if decode_s_off > 0
+        else 0.0
+    )
+    rec = {
+        "metric": "decode_rows_per_sec_per_chip",
+        "value": round(n_rows / on_s, 1),
+        "unit": "rows/s",
+        "rows": n_rows,
+        "columns": plan["cols_total"],
+        "decode_ab": {
+            "off_s": round(off_s, 2),
+            "on_s": round(on_s, 2),
+            "speedup_pct": round(100.0 * (off_s - on_s) / off_s, 1),
+            "decode_s_off": round(decode_s_off, 2),
+            "decode_s_on": round(decode_s_on, 2),
+            "decode_reduction_pct": round(reduction, 1),
+            "decode_stage_s_off": round(stage_s_off, 2),
+            "decode_stage_s_on": round(stage_s_on, 2),
+            "decode_s_workers_n": round(decode_s_pool, 2),
+            "workers_n": workers_n,
+            "cols_fast": plan["cols_fast"],
+            "cols_total": plan["cols_total"],
+            "bit_identical": True,
+            "page_cache_dropped": cache_dropped,
+            "passes": (
+                "traced warm-up (on) for planner verdicts + one traced "
+                "warm pass per side for decode self-seconds + one traced "
+                "pass at the default worker count; both timed passes "
+                "are warm-jit, cold-IO, untraced, workers=1"
+            ),
+        },
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "BENCH_DECODE.json")
+    try:
+        with open(out_path) as fh:
+            old = json.load(fh)
+        for key in ("round", "config"):
+            if key in old and key not in rec:
+                rec[key] = old[key]
+    except Exception:  # noqa: BLE001 - first write: no fields to carry
+        pass
+    with open(out_path, "w") as fh:
+        json.dump(rec, fh)
+        fh.write("\n")
+    print(
+        f"# bench: decode A/B off={off_s:.2f}s on={on_s:.2f}s "
+        f"(+{100.0 * (off_s - on_s) / off_s:.1f}%), decode self "
+        f"{decode_s_off:.2f}s -> {decode_s_on:.2f}s (-{reduction:.1f}%), "
+        f"{plan['cols_fast']}/{plan['cols_total']} cols fast; "
+        f"gen={gen_s:.1f}s",
+        file=sys.stderr,
+    )
+    print(json.dumps(rec))
+
+
 def _stream_shape() -> str:
     return os.environ.get("BENCH_STREAM_SHAPE", "default")
 
@@ -887,6 +1172,11 @@ def main() -> None:
         # self-contained A/B with its own JSON record and artifact;
         # none of the baseline machinery below applies
         run_pushdown_bench(n_rows)
+        return
+
+    if mode == "decode":
+        # self-contained A/B with its own JSON record and artifact
+        run_decode_bench(n_rows)
         return
 
     t_gen = time.perf_counter()

@@ -1,0 +1,185 @@
+"""Buffer-level Arrow decode: the fast path behind Table.from_arrow.
+
+For a planner-approved column (ops/fused.py:plan_decode_fastpath) this
+module walks the column's chunks and hands each chunk's raw buffers —
+values, validity BITMAP, dictionary index buffer — to the C kernels in
+ops/native/decode.c, which write the engine Column backing in one pass
+(neutral fill in null slots, uint8 mask, NaN fold for floats). No
+intermediate numpy arrays, no bitmap byte-expansion, no fill_null copy.
+
+Every function returns None whenever the native route cannot take the
+input (library unavailable, unexpected buffer layout, multi-chunk
+dictionary); Table.from_arrow then re-decodes the column through the
+host fallback chain. Both paths produce bit-identical Columns, so
+eligibility is purely a performance decision.
+
+tools/lint.py's DECODE rule bans `.to_numpy(`/`np.frombuffer` copy
+idioms in this module — host materialization belongs to the designated
+fallbacks in data/table.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deequ_tpu.data.table import (
+    Column,
+    ColumnType,
+    _arrow_dictionary_digest,
+    _arrow_logical_decimal,
+    dictionary_uniques_fallback,
+    gather_with_null,
+    pool_empty,
+    shared_all_true,
+)
+from deequ_tpu.ops import native
+
+
+def decode_fast_column(
+    name: str, chunks: List, arrow_table, shared: Dict[str, np.ndarray]
+) -> Optional[Column]:
+    """Decode one column's chunks through the native kernels.
+
+    `chunks` are the raw (possibly sliced) arrow chunks — never
+    combined; each chunk decodes at its row offset into one
+    preallocated output, so multi-chunk columns cost no concat copy.
+    Returns None to route the column to the host fallback."""
+    import pyarrow as pa
+
+    if not chunks or not native.available():
+        return None
+    t = chunks[0].type
+    if pa.types.is_dictionary(t):
+        return _decode_dictionary(name, chunks, shared)
+    if pa.types.is_boolean(t):
+        return _decode_boolean(name, chunks, shared)
+    spec = native.DECODE_PRIMITIVES.get(str(t))
+    if spec is None:
+        return None
+    return _decode_primitive(name, chunks, arrow_table, shared, str(t), spec)
+
+
+def _validity_addr(arr) -> Optional[int]:
+    """Address of the chunk's validity bitmap, or None when null-free.
+    A chunk with nulls always has buffer 0 in arrow's layout."""
+    bufs = arr.buffers()
+    if arr.null_count == 0 or bufs[0] is None:
+        return None
+    return bufs[0].address
+
+
+def _decode_primitive(name, chunks, arrow_table, shared, kind, spec):
+    fn_name, itemsize = spec
+    is_float = kind in ("double", "float")
+    n = sum(len(c) for c in chunks)
+    # outputs come from the arrow pool: recycled warm pages instead of a
+    # fresh mmap the kernel then page-faults through (see pool_empty)
+    out_vals = pool_empty(n, np.float64 if is_float else np.int64)
+    out_valid = pool_empty(n, np.bool_)
+    invalid = 0
+    pos = 0
+    for ch in chunks:
+        bufs = ch.buffers()
+        if len(bufs) != 2 or bufs[1] is None:
+            return None
+        rc = native.decode_primitive(
+            kind,
+            bufs[1].address + ch.offset * itemsize,
+            _validity_addr(ch),
+            ch.offset,
+            len(ch),
+            out_vals[pos:],
+            out_valid[pos:],
+        )
+        if rc is None:
+            return None
+        invalid += rc
+        pos += len(ch)
+    # invalid == 0 covers the fallback's two mask elisions at once:
+    # null-free chunks AND (for floats) no NaN folds
+    valid = shared_all_true(shared, n) if invalid == 0 else out_valid
+    if is_float:
+        ctype = (
+            ColumnType.DECIMAL
+            if _arrow_logical_decimal(arrow_table, name)
+            else ColumnType.DOUBLE
+        )
+    else:
+        ctype = ColumnType.LONG
+    return Column(name, ctype, out_vals, valid)
+
+
+def _decode_boolean(name, chunks, shared):
+    n = sum(len(c) for c in chunks)
+    out_vals = pool_empty(n, np.bool_)
+    out_valid = pool_empty(n, np.bool_)
+    invalid = 0
+    pos = 0
+    for ch in chunks:
+        bufs = ch.buffers()
+        if len(bufs) != 2 or bufs[1] is None:
+            return None
+        # the values buffer is itself a bitmap sharing the chunk's offset
+        rc = native.decode_bool_bitmap(
+            bufs[1].address,
+            ch.offset,
+            _validity_addr(ch),
+            ch.offset,
+            len(ch),
+            out_vals[pos:],
+            out_valid[pos:],
+        )
+        if rc is None:
+            return None
+        invalid += rc
+        pos += len(ch)
+    valid = shared_all_true(shared, n) if invalid == 0 else out_valid
+    return Column(name, ColumnType.BOOLEAN, out_vals, valid)
+
+
+def _decode_dictionary(name, chunks, shared):
+    """dictionary<string, int32> via the index-buffer kernel. Multi-chunk
+    dictionary columns need dictionary unification, which only the
+    combine_chunks fallback performs — route those back."""
+    import pyarrow as pa
+
+    if len(chunks) != 1:
+        return None
+    arr = chunks[0]
+    t = arr.type
+    if not (
+        pa.types.is_string(t.value_type) or pa.types.is_large_string(t.value_type)
+    ):
+        return None
+    if t.index_type != pa.int32():
+        return None
+    idx = arr.indices
+    bufs = idx.buffers()
+    if len(bufs) != 2 or bufs[1] is None:
+        return None
+    n = len(idx)
+    codes = pool_empty(n, np.int32)
+    out_valid = pool_empty(n, np.bool_)
+    rc = native.decode_dict_codes(
+        bufs[1].address + idx.offset * 4,
+        _validity_addr(idx),
+        idx.offset,
+        n,
+        codes,
+        out_valid,
+    )
+    if rc is None:
+        return None
+    valid = shared_all_true(shared, n) if rc == 0 else out_valid
+    uniques = dictionary_uniques_fallback(arr.dictionary)
+    col = Column(
+        name,
+        ColumnType.STRING,
+        lambda codes=codes, uniques=uniques: gather_with_null(uniques, codes, ""),
+        valid,
+    )
+    col._cache["dict_encode"] = (codes, uniques)
+    col._dict_content_key = _arrow_dictionary_digest(arr.dictionary)
+    return col

@@ -51,6 +51,21 @@ def _arrow_ctype(t) -> ColumnType:
     return ColumnType.STRING
 
 
+def _decode_table(arrow_table, fastpath) -> Table:
+    """Arrow batch -> engine Table under an `arrow_decode` span.
+
+    The span isolates the buffer->wire conversion self-time from the
+    parquet read/decompression that surrounds it in the decode stage,
+    so traces (and BENCH_DECODE.json) report the exact seconds the
+    decode fast path targets."""
+    sp = _spans.span("arrow_decode", cat="decode")
+    with sp:
+        table = Table.from_arrow(arrow_table, fastpath)
+        if sp:
+            sp.set(rows=int(table.num_rows), fast=bool(fastpath))
+    return table
+
+
 def _empty_column(name: str, ctype: ColumnType) -> Column:
     backing = NUMPY_BACKING[ctype]
     return Column(
@@ -254,6 +269,7 @@ class ParquetSource(DataSource):
         columns: Optional[List[str]] = None,
         batch_rows: int = 1 << 22,
         prune_groups: Optional[Sequence[int]] = None,
+        decode_fastpath: Optional[Sequence[str]] = None,
     ):
         import pyarrow.parquet as pq
 
@@ -264,6 +280,12 @@ class ParquetSource(DataSource):
         # scan never reads them, so num_rows reports decoded rows only
         self.prune_groups = (
             frozenset(int(g) for g in prune_groups) if prune_groups else None
+        )
+        # columns the planner approved for the buffer-level native decode
+        # (ops/fused.py:plan_decode_fastpath → with_decode_fastpath);
+        # None/empty = every column takes the host from_arrow chain
+        self.decode_fastpath = (
+            frozenset(decode_fastpath) if decode_fastpath else None
         )
         pf = pq.ParquetFile(path)
         meta = pf.metadata
@@ -302,6 +324,7 @@ class ParquetSource(DataSource):
             columns=keep,
             batch_rows=self.batch_rows,
             prune_groups=self.prune_groups,
+            decode_fastpath=self.decode_fastpath,
         )
 
     def with_prune(self, skip) -> "ParquetSource":
@@ -319,7 +342,55 @@ class ParquetSource(DataSource):
             columns=self.columns,
             batch_rows=self.batch_rows,
             prune_groups=skip,
+            decode_fastpath=self.decode_fastpath,
         )
+
+    def with_decode_fastpath(self, names) -> "ParquetSource":
+        """Fast-decode view: `names` are the columns the planner proved
+        eligible for the buffer-level native decode. Pure routing — the
+        fast and fallback decode emit bit-identical Columns — so this
+        composes freely with with_columns/with_prune."""
+        names = frozenset(names)
+        if not names or names == (self.decode_fastpath or frozenset()):
+            return self
+        return ParquetSource(
+            self.path,
+            columns=self.columns,
+            batch_rows=self.batch_rows,
+            prune_groups=self.prune_groups,
+            decode_fastpath=names,
+        )
+
+    def decode_column_types(self):
+        """Arrow type tokens per scanned column AS THE SCAN DECODES THEM
+        (string columns arrive dictionary-encoded via read_dictionary,
+        with int32 indices) — the pure vocabulary the decode planner
+        (ops/fused.py:classify_decode_columns) and the cost model key
+        against ops/native.DECODE_PRIMITIVES, keeping both pyarrow-free.
+        This is the only reader of the arrow schema for decode planning,
+        like row_group_stats is for pushdown."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        out = {}
+        pf = pq.ParquetFile(self.path)
+        try:
+            arrow_schema = pf.schema_arrow
+            for name, _ in self._schema_cache:
+                t = arrow_schema.field(name).type
+                if pa.types.is_string(t) or pa.types.is_large_string(t):
+                    # read_dictionary rewrites these on the way in
+                    out[name] = "dictionary<string,int32>"
+                elif pa.types.is_dictionary(t) and (
+                    pa.types.is_string(t.value_type)
+                    or pa.types.is_large_string(t.value_type)
+                ) and t.index_type == pa.int32():
+                    out[name] = "dictionary<string,int32>"
+                else:
+                    out[name] = str(t)
+        finally:
+            pf.close()
+        return out
 
     def row_group_stats(self):
         """Per-row-group parquet statistics as pure records for the
@@ -368,11 +439,30 @@ class ParquetSource(DataSource):
             pf.close()
         return out
 
+    def _decode_fastpath_set(self) -> Optional[frozenset]:
+        """The planner-approved fast-decode set, or None when the knob
+        forces the host chain (the decode differential's baseline)."""
+        from deequ_tpu.ops import runtime
+
+        if self.decode_fastpath and runtime.decode_fastpath_enabled():
+            return self.decode_fastpath
+        return None
+
     def _iter_tables(self, batch_size: int) -> Iterator[Table]:
+        from deequ_tpu.ops import runtime
+
+        workers = runtime.decode_workers()
+        if workers > 1:
+            yield from self._iter_tables_parallel(batch_size, workers)
+        else:
+            yield from self._iter_tables_serial(batch_size)
+
+    def _iter_tables_serial(self, batch_size: int) -> Iterator[Table]:
         import pyarrow.parquet as pq
 
         from deequ_tpu.ops import runtime
 
+        fastpath = self._decode_fastpath_set()
         size = min(batch_size, self.batch_rows)
         # Read row group by row group: this pyarrow's iter_batches /
         # dataset scanner retain every decoded batch in the pool for the
@@ -440,14 +530,152 @@ class ParquetSource(DataSource):
                     head = flush()
                     pending_rows = 0
                     for start in range(0, head.num_rows, size):
-                        yield Table.from_arrow(head.slice(start, size))
+                        yield _decode_table(head.slice(start, size), fastpath)
                 for start in range(0, group.num_rows, size):
-                    yield Table.from_arrow(group.slice(start, size))
+                    yield _decode_table(group.slice(start, size), fastpath)
                 del group
             tail = flush()
             if tail is not None:
                 for start in range(0, tail.num_rows, size):
-                    yield Table.from_arrow(tail.slice(start, size))
+                    yield _decode_table(tail.slice(start, size), fastpath)
+
+    def _plan_decode_units(self, size: int) -> List[Tuple[int, ...]]:
+        """Replay the serial loop's coalescing decisions from metadata
+        alone: every branch there depends only on each group's row count,
+        so the unit list — each unit a tuple of row-group indices whose
+        concat is sliced into batches — reproduces the serial batch
+        sequence EXACTLY. This is what keeps the parallel decode
+        bit-identical at any worker count."""
+        import pyarrow.parquet as pq
+
+        pf = pq.ParquetFile(self.path)
+        try:
+            meta = pf.metadata
+            rows = [
+                meta.row_group(g).num_rows for g in range(meta.num_row_groups)
+            ]
+        finally:
+            pf.close()
+        tiny = max(1, size // 4)
+        units: List[Tuple[int, ...]] = []
+        pending: List[int] = []
+        pending_rows = 0
+        skip = self.prune_groups
+        for g, num in enumerate(rows):
+            if skip is not None and g in skip:
+                continue
+            if num < tiny:
+                pending.append(g)
+                pending_rows += num
+                if pending_rows < size:
+                    continue
+                units.append(tuple(pending))
+                pending = []
+                pending_rows = 0
+            else:
+                if pending:
+                    units.append(tuple(pending))
+                    pending = []
+                    pending_rows = 0
+                units.append((g,))
+        if pending:
+            units.append(tuple(pending))
+        return units
+
+    def _iter_tables_parallel(
+        self, batch_size: int, workers: int
+    ) -> Iterator[Table]:
+        """Row-group decode fanned across `workers` threads with an
+        ordered merge: units (see _plan_decode_units) are submitted in
+        serial order and results yielded in submission order, so the
+        batch sequence is bit-identical to the serial loop. pyarrow's
+        parquet decode and the native kernels release the GIL, so the
+        units genuinely overlap. Each worker thread opens its OWN
+        ParquetFile (the handle is not thread-safe); in-flight units are
+        bounded at workers + 1, so host memory stays
+        O(workers × row group)."""
+        import collections
+        from concurrent.futures import ThreadPoolExecutor
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from deequ_tpu.ops import runtime
+
+        fastpath = self._decode_fastpath_set()
+        size = min(batch_size, self.batch_rows)
+        units = self._plan_decode_units(size)
+        if not units:
+            return
+        stall_s = runtime.source_stall_s()
+        str_cols = [
+            n for n, t in self._schema_cache if t == ColumnType.STRING
+        ]
+        tracer = _spans.current_tracer()
+        parent = _spans.current_span()
+        local = threading.local()
+        open_files: List = []
+        files_lock = threading.Lock()
+
+        def _pf():
+            pf = getattr(local, "pf", None)
+            if pf is None:
+                pf = pq.ParquetFile(
+                    self.path, read_dictionary=str_cols or None
+                )
+                local.pf = pf
+                with files_lock:
+                    open_files.append(pf)
+            return pf
+
+        def decode_unit(unit: Tuple[int, ...]) -> List[Table]:
+            pf = _pf()
+            with _spans.attached(tracer, parent):
+                with _spans.span(
+                    "decode_unit", cat="decode", groups=len(unit)
+                ) as sp:
+                    parts = []
+                    for g in unit:
+                        if stall_s > 0.0:
+                            time.sleep(stall_s)
+                        parts.append(
+                            pf.read_row_group(g, columns=self.columns)
+                        )
+                    merged = (
+                        parts[0] if len(parts) == 1 else pa.concat_tables(parts)
+                    )
+                    del parts
+                    tables = [
+                        _decode_table(merged.slice(start, size), fastpath)
+                        for start in range(0, merged.num_rows, size)
+                    ]
+                    if sp:
+                        sp.set(rows=int(merged.num_rows))
+                    return tables
+
+        pool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="deequ-decode-worker"
+        )
+        pending = collections.deque()
+        next_unit = 0
+        try:
+            while next_unit < len(units) or pending:
+                while next_unit < len(units) and len(pending) < workers + 1:
+                    pending.append(pool.submit(decode_unit, units[next_unit]))
+                    next_unit += 1
+                fut = pending.popleft()
+                for table in fut.result():
+                    yield table
+        finally:
+            for fut in pending:
+                fut.cancel()
+            pool.shutdown(wait=True)
+            with files_lock:
+                for pf in open_files:
+                    try:
+                        pf.close()
+                    except Exception:  # noqa: BLE001 - teardown best-effort
+                        pass
 
     def __repr__(self) -> str:
         return f"ParquetSource({self.path!r}, rows={self._num_rows})"

@@ -425,6 +425,178 @@ def _column_from_list(name: str, values: Sequence, ctype: Optional[ColumnType]) 
     return Column(name, ctype, arr, valid)
 
 
+def shared_all_true(shared: Dict[str, np.ndarray], n: int) -> np.ndarray:
+    """One read-only all-true mask shared by every null-free column of a
+    batch (lets pack elide mask work via `valid.all()` without a scan
+    per column). `shared` is the per-from_arrow scratch dict."""
+    mask = shared.get("all_true")
+    if mask is None or len(mask) != n:
+        mask = np.ones(n, dtype=bool)
+        mask.setflags(write=False)
+        shared["all_true"] = mask
+    return mask
+
+
+def pool_empty(n: int, dtype) -> np.ndarray:
+    """Uninitialized Column backing allocated from the arrow memory pool.
+
+    Streaming decode allocates and keeps dozens of outputs per batch;
+    fresh `np.empty` arrays at that size come from new mmaps, so the
+    decode kernels pay a page fault per 4KB on first touch. The arrow
+    pool recycles the previous batch's pages (it already backs the
+    fallback's `fill_null` outputs), which measures ~1.8x faster per
+    column on the wide-stream shape. Degrades to `np.empty` when
+    pyarrow is unavailable."""
+    try:
+        import pyarrow as pa
+    except ImportError:
+        return np.empty(n, dtype=dtype)
+    dt = np.dtype(dtype)
+    buf = pa.allocate_buffer(int(n) * dt.itemsize)
+    out = np.frombuffer(buf, dtype=dt)
+    # np.frombuffer honors the source's mutability; assert rather than
+    # silently hand the kernels a read-only backing
+    assert out.flags.writeable
+    return out
+
+
+def _column_from_arrow_fallback(name, arr, arrow_table, shared) -> Column:
+    """Host-side decode of one (already combined) arrow chunk.
+
+    This is the designated fallback behind the native fast path
+    (data/arrow_decode.py): columns whose values must exist host-side
+    (plain strings, decimals) or whose layout the native kernels don't
+    take land here. tools/lint.py's DECODE rule keeps `.to_numpy(` copy
+    idioms confined to this chain."""
+    import pyarrow as pa
+
+    if pa.types.is_dictionary(arr.type) and not (
+        pa.types.is_string(arr.type.value_type)
+        or pa.types.is_large_string(arr.type.value_type)
+    ):
+        # only string dictionaries have a first-class code path;
+        # others decode to their value type so the column's ctype
+        # matches what _arrow_ctype reports for the schema
+        arr = arr.dictionary_decode()
+    # null-free columns skip the fill_null/where copies and get
+    # zero-copy numpy views of the arrow buffers where possible
+    # (views are read-only; Column treats values as immutable,
+    # which also lets all null-free columns share one mask)
+    no_nulls = arr.null_count == 0
+    if no_nulls:
+        valid = shared_all_true(shared, len(arr))
+    else:
+        valid = np.asarray(arr.is_valid())
+    t = arr.type
+    if pa.types.is_boolean(t):
+        vals = np.asarray(arr if no_nulls else arr.fill_null(False))
+        return Column(name, ColumnType.BOOLEAN, vals, valid)
+    elif pa.types.is_integer(t):
+        vals = np.asarray(arr if no_nulls else arr.fill_null(0))
+        if vals.dtype != np.int64:
+            vals = vals.astype(np.int64)
+        return Column(name, ColumnType.LONG, vals, valid)
+    elif pa.types.is_floating(t):
+        vals = np.asarray(arr if no_nulls else arr.fill_null(0.0))
+        if vals.dtype != np.float64:
+            vals = vals.astype(np.float64)
+        nan = np.isnan(vals)
+        if nan.any():
+            valid = valid & ~nan
+            vals = np.where(valid, vals, 0.0)
+        # a float64 field annotated by to_arrow keeps its
+        # DECIMAL ctype across the arrow/parquet round trip
+        # (values were float64 already; only the logical type
+        # needs restoring)
+        ctype = (
+            ColumnType.DECIMAL
+            if _arrow_logical_decimal(arrow_table, name)
+            else ColumnType.DOUBLE
+        )
+        return Column(name, ctype, vals, valid)
+    elif pa.types.is_decimal(t):
+        vals = np.array(
+            [float(v) if v is not None else 0.0 for v in arr.to_pylist()],
+            dtype=np.float64,
+        )
+        return Column(name, ColumnType.DECIMAL, vals, valid)
+    elif pa.types.is_timestamp(t):
+        vals = np.asarray(arr.cast(pa.timestamp("us")).fill_null(0))
+        return Column(
+            name, ColumnType.TIMESTAMP, vals.astype("datetime64[us]"), valid
+        )
+    elif pa.types.is_dictionary(t) and (
+        pa.types.is_string(t.value_type)
+        or pa.types.is_large_string(t.value_type)
+    ):
+        # dictionary-decoded string column (ParquetSource reads
+        # string columns this way): the codes ARE the dict_encode
+        # result — no per-row string materialization, no re-encode.
+        # `values` stays lazy; only consumers that truly need
+        # per-row python strings pay the gather.
+        # int32 stays int32: arrow dictionary indices feed
+        # bincount/gathers directly (the int64 upcast cost a
+        # copy plus double the bincount traffic); null-free
+        # indices map zero-copy
+        idx = arr.indices
+        if idx.null_count == 0:
+            codes = idx.to_numpy(zero_copy_only=True)
+        else:
+            codes = idx.fill_null(-1).to_numpy(zero_copy_only=False)
+        uniques = dictionary_uniques_fallback(arr.dictionary)
+        col = Column(
+            name,
+            ColumnType.STRING,
+            lambda codes=codes, uniques=uniques: gather_with_null(
+                uniques, codes, ""
+            ),
+            valid,
+        )
+        col._cache["dict_encode"] = (codes, uniques)
+        col._dict_content_key = _arrow_dictionary_digest(
+            arr.dictionary
+        )
+        return col
+    elif pa.types.is_string(t) or pa.types.is_large_string(t):
+        vals = arr.to_numpy(zero_copy_only=False)
+        if vals.dtype != object:
+            vals = vals.astype(object)
+        if not valid.all():
+            vals[~valid] = ""
+        col = Column(name, ColumnType.STRING, vals, valid)
+        # keep the arrow array: dict_encode uses its C hash-based
+        # dictionary_encode instead of a sort-based np.unique
+        col._cache["arrow"] = arr
+        return col
+    else:
+        py = arr.to_pylist()
+        vals = np.empty(len(py), dtype=object)
+        for i, v in enumerate(py):
+            vals[i] = str(v) if v is not None else ""
+        return Column(name, ColumnType.STRING, vals, valid)
+
+
+def _arrow_logical_decimal(arrow_table, name: str) -> bool:
+    """True when the float64 field carries the deequ_tpu DECIMAL
+    logical-type annotation written by Table.to_arrow."""
+    try:
+        md = arrow_table.schema.field(name).metadata or {}
+    except Exception:  # noqa: BLE001 - schemaless inputs
+        md = {}
+    return md.get(b"deequ_tpu.logical_type") == ColumnType.DECIMAL.value.encode()
+
+
+def dictionary_uniques_fallback(dictionary) -> np.ndarray:
+    """Designated fallback: materialize a dictionary's uniques as a host
+    object array. This is the only host-side string materialization the
+    dictionary decode paths (native and fallback) perform — per-row
+    strings stay lazy."""
+    uniques = dictionary.to_numpy(zero_copy_only=False)
+    if uniques.dtype != object:
+        uniques = uniques.astype(object)
+    return uniques
+
+
 class Table:
     """Immutable columnar table."""
 
@@ -556,141 +728,52 @@ class Table:
         return Table(cols)
 
     @staticmethod
-    def from_arrow(arrow_table) -> "Table":
+    def from_arrow(arrow_table, fastpath_columns=None) -> "Table":
+        """Decode an arrow table into engine Columns.
+
+        `fastpath_columns` (a set of names, normally threaded through
+        `ParquetSource.with_decode_fastpath` by the planner's
+        `plan_decode_fastpath`) routes those columns through the
+        buffer-level native decode (data/arrow_decode.py + ops/native/
+        decode.c): one C pass from arrow buffers to the Column backing,
+        no intermediate numpy materialization. Any column the native
+        path cannot take (missing library, unexpected layout) falls back
+        to the host chain automatically — the two produce bit-identical
+        Columns, so the fast path is a pure perf decision."""
         import pyarrow as pa
 
         cols = []
-        all_true = None  # one read-only mask shared by every null-free column
+        shared: Dict[str, np.ndarray] = {}  # one mask for null-free columns
+        fast = None
+        if fastpath_columns:
+            from deequ_tpu.data import arrow_decode
+
+            fast = arrow_decode.decode_fast_column
         for name in arrow_table.column_names:
             chunked = arrow_table.column(name)
             if isinstance(chunked, pa.ChunkedArray):
-                # single-chunk columns (every row-group/slice read) skip
-                # the combine_chunks memcpy; the chunk may carry a slice
-                # offset, which every consumer below handles
-                if chunked.num_chunks == 1:
-                    arr = chunked.chunk(0)
-                elif chunked.num_chunks == 0:
-                    arr = pa.array([], chunked.type)
-                else:
-                    arr = chunked.combine_chunks()
-                    if isinstance(arr, pa.ChunkedArray):
-                        arr = arr.chunk(0)
+                chunks = list(chunked.chunks)
             else:
-                arr = chunked
-            if pa.types.is_dictionary(arr.type) and not (
-                pa.types.is_string(arr.type.value_type)
-                or pa.types.is_large_string(arr.type.value_type)
-            ):
-                # only string dictionaries have a first-class code path;
-                # others decode to their value type so the column's ctype
-                # matches what _arrow_ctype reports for the schema
-                arr = arr.dictionary_decode()
-            # null-free columns skip the fill_null/where copies and get
-            # zero-copy numpy views of the arrow buffers where possible
-            # (views are read-only; Column treats values as immutable,
-            # which also lets all null-free columns share one mask)
-            no_nulls = arr.null_count == 0
-            if no_nulls:
-                if all_true is None or len(all_true) != len(arr):
-                    all_true = np.ones(len(arr), dtype=bool)
-                    all_true.setflags(write=False)
-                valid = all_true
+                chunks = [chunked]
+            if fast is not None and name in fastpath_columns:
+                col = fast(name, chunks, arrow_table, shared)
+                if col is not None:
+                    cols.append(col)
+                    continue
+            # single-chunk columns (every row-group/slice read) skip
+            # the combine_chunks memcpy; the chunk may carry a slice
+            # offset, which every consumer below handles
+            if len(chunks) == 1:
+                arr = chunks[0]
+            elif not chunks:
+                arr = pa.array([], chunked.type)
             else:
-                valid = np.asarray(arr.is_valid())
-            t = arr.type
-            if pa.types.is_boolean(t):
-                vals = np.asarray(arr if no_nulls else arr.fill_null(False))
-                cols.append(Column(name, ColumnType.BOOLEAN, vals, valid))
-            elif pa.types.is_integer(t):
-                vals = np.asarray(arr if no_nulls else arr.fill_null(0))
-                if vals.dtype != np.int64:
-                    vals = vals.astype(np.int64)
-                cols.append(Column(name, ColumnType.LONG, vals, valid))
-            elif pa.types.is_floating(t):
-                vals = np.asarray(arr if no_nulls else arr.fill_null(0.0))
-                if vals.dtype != np.float64:
-                    vals = vals.astype(np.float64)
-                nan = np.isnan(vals)
-                if nan.any():
-                    valid = valid & ~nan
-                    vals = np.where(valid, vals, 0.0)
-                # a float64 field annotated by to_arrow keeps its
-                # DECIMAL ctype across the arrow/parquet round trip
-                # (values were float64 already; only the logical type
-                # needs restoring)
-                try:
-                    md = arrow_table.schema.field(name).metadata or {}
-                except Exception:  # noqa: BLE001 - schemaless inputs
-                    md = {}
-                ctype = (
-                    ColumnType.DECIMAL
-                    if md.get(b"deequ_tpu.logical_type")
-                    == ColumnType.DECIMAL.value.encode()
-                    else ColumnType.DOUBLE
-                )
-                cols.append(Column(name, ctype, vals, valid))
-            elif pa.types.is_decimal(t):
-                vals = np.array(
-                    [float(v) if v is not None else 0.0 for v in arr.to_pylist()],
-                    dtype=np.float64,
-                )
-                cols.append(Column(name, ColumnType.DECIMAL, vals, valid))
-            elif pa.types.is_timestamp(t):
-                vals = np.asarray(arr.cast(pa.timestamp("us")).fill_null(0))
-                cols.append(
-                    Column(name, ColumnType.TIMESTAMP, vals.astype("datetime64[us]"), valid)
-                )
-            elif pa.types.is_dictionary(t) and (
-                pa.types.is_string(t.value_type)
-                or pa.types.is_large_string(t.value_type)
-            ):
-                # dictionary-decoded string column (ParquetSource reads
-                # string columns this way): the codes ARE the dict_encode
-                # result — no per-row string materialization, no re-encode.
-                # `values` stays lazy; only consumers that truly need
-                # per-row python strings pay the gather.
-                # int32 stays int32: arrow dictionary indices feed
-                # bincount/gathers directly (the int64 upcast cost a
-                # copy plus double the bincount traffic); null-free
-                # indices map zero-copy
-                idx = arr.indices
-                if idx.null_count == 0:
-                    codes = idx.to_numpy(zero_copy_only=True)
-                else:
-                    codes = idx.fill_null(-1).to_numpy(zero_copy_only=False)
-                uniques = arr.dictionary.to_numpy(zero_copy_only=False)
-                if uniques.dtype != object:
-                    uniques = uniques.astype(object)
-                col = Column(
-                    name,
-                    ColumnType.STRING,
-                    lambda codes=codes, uniques=uniques: gather_with_null(
-                        uniques, codes, ""
-                    ),
-                    valid,
-                )
-                col._cache["dict_encode"] = (codes, uniques)
-                col._dict_content_key = _arrow_dictionary_digest(
-                    arr.dictionary
-                )
-                cols.append(col)
-            elif pa.types.is_string(t) or pa.types.is_large_string(t):
-                vals = arr.to_numpy(zero_copy_only=False)
-                if vals.dtype != object:
-                    vals = vals.astype(object)
-                if not valid.all():
-                    vals[~valid] = ""
-                col = Column(name, ColumnType.STRING, vals, valid)
-                # keep the arrow array: dict_encode uses its C hash-based
-                # dictionary_encode instead of a sort-based np.unique
-                col._cache["arrow"] = arr
-                cols.append(col)
-            else:
-                py = arr.to_pylist()
-                vals = np.empty(len(py), dtype=object)
-                for i, v in enumerate(py):
-                    vals[i] = str(v) if v is not None else ""
-                cols.append(Column(name, ColumnType.STRING, vals, valid))
+                arr = chunked.combine_chunks()
+                if isinstance(arr, pa.ChunkedArray):
+                    arr = arr.chunk(0)
+            cols.append(
+                _column_from_arrow_fallback(name, arr, arrow_table, shared)
+            )
         return Table(cols)
 
     def to_arrow(self, dictionary_encode_strings: bool = False):

@@ -102,6 +102,17 @@ class PassCost:
     rg_total: Optional[int] = None
     rg_skipped: Optional[int] = None
     saved_read_bytes: Optional[float] = None
+    #: decode fast-path prediction (scan passes over parquet sources
+    #: whose decode vocabulary was provided): columns the native
+    #: buffer-level decode will take / columns scanned / per-column
+    #: fallback reasons / bytes of intermediate host materialization the
+    #: fast columns avoid over the decoded rows. None = no decode
+    #: vocabulary (in-memory table) or the fast path is unavailable.
+    decode_cols_total: Optional[int] = None
+    decode_cols_fast: Optional[int] = None
+    decode_fallbacks: Tuple[Tuple[str, str], ...] = ()
+    saved_decode_bytes: Optional[float] = None
+    decode_workers: Optional[int] = None
     family_groups: Tuple[FamilyGroupCost, ...] = ()
     #: grouping passes: estimated distinct-group count (product of
     #: `approx_distinct` hints); None when any hint is missing
@@ -265,6 +276,14 @@ def cost_drift(cost: "PlanCost", trace: Any) -> Dict[str, float]:
             out["drift.rg_skipped"] = float(
                 int(trace.counters.get("rg_skipped", 0)) - scan.rg_skipped
             )
+        if (
+            scan.decode_cols_fast is not None
+            and "decode_cols_total" in trace.counters
+        ):
+            out["drift.decode_cols_fast"] = float(
+                int(trace.counters.get("decode_cols_fast", 0))
+                - scan.decode_cols_fast
+            )
     return out
 
 
@@ -350,6 +369,7 @@ def analyze_plan(
     link_bandwidth: Optional[float] = None,
     pipeline_depth: Optional[int] = None,
     row_groups: Optional[Sequence[Any]] = None,
+    decode_types: Optional[Dict[str, str]] = None,
 ) -> PlanCost:
     """Abstract interpretation of `AnalysisRunner._do_analysis_run`:
     dedupe -> static precondition filtering (zero-row table) ->
@@ -370,7 +390,14 @@ def analyze_plan(
     pushdown model: batch count and first-batch rows come from an exact
     replay of the source's row-group iteration over the groups the
     runtime will actually decode, and the pass reports predicted
-    skipped/decoded groups + saved read bytes."""
+    skipped/decoded groups + saved read bytes.
+
+    `decode_types` (`ParquetSource.decode_column_types()`) switches on
+    the decode fast-path prediction: the scan pass reports which columns
+    the buffer-level native decode will take, the per-column fallback
+    reasons, and the intermediate materialization bytes avoided — via
+    the SAME classifier the runtime planner runs, so
+    `drift.decode_cols_fast` pins to zero."""
     from deequ_tpu.analyzers.base import Preconditions, ScanShareableAnalyzer
     from deequ_tpu.analyzers.frequency import (
         FrequencyBasedAnalyzer,
@@ -569,6 +596,69 @@ def analyze_plan(
                 if pushdown_on
                 else 0.0
             )
+
+        # ---- decode fast-path (parquet decode vocabulary available) -----
+        # Mirrors FusedScanPass.run's plan_decode_fastpath exactly: same
+        # knob, same native-library gate, same classifier over the same
+        # post-pruning, post-elision column set — so the prediction pins
+        # to the observed decode_cols_fast counter with zero drift.
+        if decode_types and plan.any_members:
+            from deequ_tpu.ops import native
+            from deequ_tpu.ops.fused import (
+                DecodePlan,
+                classify_decode_columns,
+                decode_saved_bytes_per_row,
+            )
+
+            if runtime.decode_fastpath_enabled() and native.available():
+                specs_eff = {
+                    k: s for k, s in plan.specs.items() if k not in elided_keys
+                }
+                needed: set = set()
+                prunable = True
+                for spec in specs_eff.values():
+                    if spec.columns is None:
+                        prunable = False
+                        break
+                    needed.update(spec.columns)
+                if not prunable:
+                    kept = list(decode_types)
+                elif needed:
+                    kept = [n for n in decode_types if n in needed]
+                else:
+                    # Size()-only pass: the source keeps its first column
+                    kept = list(decode_types)[:1]
+                col_types = {n: decode_types[n] for n in kept}
+                if col_types:
+                    fast, fallbacks = classify_decode_columns(
+                        col_types, specs_eff
+                    )
+                    dplan = DecodePlan(
+                        fast=tuple(fast),
+                        fallbacks=tuple(fallbacks),
+                        workers=runtime.decode_workers(),
+                    )
+                    scan_pass.decode_cols_total = dplan.total
+                    scan_pass.decode_cols_fast = len(dplan.fast)
+                    scan_pass.decode_fallbacks = dplan.fallbacks
+                    scan_pass.decode_workers = dplan.workers
+                    decoded_rows = num_rows
+                    if (
+                        decoded_rows is not None
+                        and prune_plan is not None
+                        and pushdown_on
+                    ):
+                        decoded_rows = max(
+                            0, decoded_rows - prune_plan.skipped_rows
+                        )
+                    scan_pass.saved_decode_bytes = (
+                        float(
+                            decode_saved_bytes_per_row(dplan, col_types)
+                            * decoded_rows
+                        )
+                        if decoded_rows is not None
+                        else None
+                    )
         cost.passes.append(scan_pass)
 
         if streaming:

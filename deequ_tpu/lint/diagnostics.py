@@ -48,6 +48,7 @@ CODES = {
     "DQ305": "pipeline queue depth cannot hide the measured transfer latency",
     "DQ310": "where predicate not pushdown-eligible",
     "DQ311": "statistics prove every row group skippable",
+    "DQ312": "column falls off the decode fast path",
 }
 
 

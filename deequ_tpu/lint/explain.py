@@ -249,6 +249,25 @@ def cost_diagnostics(
                     "stale file)",
                 )
             )
+
+    # DQ312 — decode fast path: columns that fall off the buffer-level
+    # native decode keep the multi-pass host from_arrow chain. Each is
+    # named with the planner's reason (the same classifier the runtime
+    # routes with), so the fix — recast a decimal/timestamp upstream, or
+    # stop consuming host string values — is actionable per column.
+    if scan is not None and scan.decode_fallbacks:
+        for col, reason in scan.decode_fallbacks:
+            diags.append(
+                Diagnostic(
+                    "DQ312",
+                    Severity.WARNING,
+                    f"column {col!r} falls off the decode fast path "
+                    f"({reason}): it decodes through the multi-pass host "
+                    "chain while fast-path columns decode in one native "
+                    "pass",
+                    source=col,
+                )
+            )
     return diags
 
 
@@ -289,6 +308,19 @@ def _render_pass(p: PassCost, idx: int) -> List[str]:
                 + (f" (saves ~{_fmt_bytes(p.saved_read_bytes)} decode)"
                    if p.saved_read_bytes else "")
             )
+        if p.decode_cols_total is not None and p.decode_cols_fast is not None:
+            line = (
+                f"  decode: {p.decode_cols_fast}/{p.decode_cols_total} "
+                "column(s) on the native fast path"
+            )
+            if p.decode_workers is not None:
+                line += f", {p.decode_workers} worker(s)"
+            if p.saved_decode_bytes:
+                line += (
+                    f" (avoids ~{_fmt_bytes(p.saved_decode_bytes)} "
+                    "intermediate)"
+                )
+            lines.append(line)
         for g in p.family_groups:
             tag = "batched" if g.batched else "solo"
             lines.append(
@@ -418,6 +450,7 @@ def explain_plan(
     link_bandwidth: Optional[float] = None,
     pipeline_depth: Optional[int] = None,
     row_groups: Optional[Sequence] = None,
+    decode_types: Optional[Dict[str, str]] = None,
 ) -> ExplainResult:
     """EXPLAIN an analysis plan against a `Table` (schema and row count
     are taken from it — still zero data scanned) or a `SchemaInfo`.
@@ -432,7 +465,11 @@ def explain_plan(
     (`row_group_stats()`) when it exposes them — reading file metadata,
     never a row — which turns on the pushdown prediction: skipped vs
     decoded row groups, the exact decode batch replay, and the
-    DQ310/DQ311 lints."""
+    DQ310/DQ311 lints.
+
+    `decode_types` likewise defaults to the source's own decode
+    vocabulary (`decode_column_types()`), which turns on the decode
+    fast-path prediction and the per-column DQ312 fallback lints."""
     if isinstance(data_or_schema, SchemaInfo):
         schema = data_or_schema
     else:
@@ -451,6 +488,13 @@ def explain_plan(
                     row_groups = stats_fn()
                 except Exception:  # noqa: BLE001 — stats are advisory
                     row_groups = None
+        if decode_types is None:
+            types_fn = getattr(data_or_schema, "decode_column_types", None)
+            if types_fn is not None:
+                try:
+                    decode_types = types_fn()
+                except Exception:  # noqa: BLE001 — advisory, like stats
+                    decode_types = None
     plan = _plan_analyzers(analyzers, checks)
     cost = analyze_plan(
         plan,
@@ -466,6 +510,7 @@ def explain_plan(
         link_bandwidth=link_bandwidth,
         pipeline_depth=pipeline_depth,
         row_groups=row_groups,
+        decode_types=decode_types,
     )
     return ExplainResult(
         cost=cost, diagnostics=cost_diagnostics(cost, plan, schema)

@@ -83,3 +83,18 @@ def record_pruned_groups(skipped: int, total: int) -> None:
     if tracer is not None:
         tracer.count("rg_skipped", int(skipped))
         tracer.count("rg_total", int(total))
+
+
+def record_decode_fastpath(fast: int, total: int, workers: int) -> None:
+    """Decode-plan outcome of one fused scan: columns routed through the
+    buffer-level native decode vs columns scanned, plus the worker count
+    the scan decodes with. Tracer-only, like record_pruned_groups; the
+    counters feed cost_drift's decode pin and the
+    `engine.decode_fastpath_ratio` / `engine.decode_workers` telemetry
+    series (decode_passes normalizes workers to a per-scan average)."""
+    tracer = spans.current_tracer()
+    if tracer is not None:
+        tracer.count("decode_cols_fast", int(fast))
+        tracer.count("decode_cols_total", int(total))
+        tracer.count("decode_workers", int(workers))
+        tracer.count("decode_passes", 1)

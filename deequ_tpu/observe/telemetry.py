@@ -155,6 +155,21 @@ def engine_metric_record(
             rec.get("engine.counter.rg_skipped", 0.0) / rg_total
         )
 
+    # derived: fraction of scanned columns the buffer-level native
+    # decode took, and the per-scan average worker count (exact when
+    # every scan ran the same pool size) — the sentinel watches both for
+    # decode-fast-path regressions; only present when a decode plan ran
+    decode_total = rec.get("engine.counter.decode_cols_total", 0.0)
+    if decode_total > 0.0:
+        rec["engine.decode_fastpath_ratio"] = (
+            rec.get("engine.counter.decode_cols_fast", 0.0) / decode_total
+        )
+    decode_passes = rec.get("engine.counter.decode_passes", 0.0)
+    if decode_passes > 0.0:
+        rec["engine.decode_workers"] = (
+            rec.get("engine.counter.decode_workers", 0.0) / decode_passes
+        )
+
     # satellite: traced_run stamps these on the root span; live /proc read
     # covers traces produced before the attributes existed.
     res = proc_resources()

@@ -529,6 +529,149 @@ def plan_row_group_prune(table, members):
         return None
 
 
+#: spec-key prefixes whose builds consume only the packed representation
+#: of a dictionary-string column (codes + mask + uniques digest) — the
+#: lazy per-row string gather provably never fires, so such columns are
+#: safe for the native decode's lazy-values Column. An unknown prefix
+#: routes the column to the host chain instead (conservative, never
+#: wrong). Numeric/bool columns skip this check: their Columns are fully
+#: materialized by both paths.
+PACKED_SAFE_PREFIXES = frozenset(
+    {
+        "num", "valid", "where", "pred", "prednn", "match", "dtclass",
+        "hll", "lcc_codes", "lcc_uniq", "optnum", "optnumv",
+    }
+)
+
+#: per-row bytes of intermediate host materialization the fast path
+#: avoids for one column: the fill_null'd arrow array copy (element
+#: width) plus the bitmap→bool mask expansion (1 byte). Prediction-only
+#: accounting for EXPLAIN/cost — never used for correctness.
+_DECODE_TOKEN_BYTES = {
+    "double": 8, "float": 4, "int8": 1, "int16": 2, "int32": 4,
+    "int64": 8, "uint8": 1, "uint16": 2, "uint32": 4, "uint64": 8,
+    "bool": 1, "dictionary<string,int32>": 4,
+}
+
+
+@dataclass(frozen=True)
+class DecodePlan:
+    """Static per-column decode routing for one parquet-backed scan:
+    which columns take the buffer-level native fast path, which fall
+    back to the host chain (with the reason, for EXPLAIN's DQ312), and
+    the worker count the scan decodes with. Purely a perf/accounting
+    decision — both routes emit bit-identical Columns."""
+
+    fast: Tuple[str, ...]
+    fallbacks: Tuple[Tuple[str, str], ...]  # (column, reason)
+    workers: int
+
+    @property
+    def total(self) -> int:
+        return len(self.fast) + len(self.fallbacks)
+
+
+def classify_decode_columns(
+    col_types: Dict[str, str], specs: Dict[str, Any]
+) -> Tuple[List[str], List[Tuple[str, str]]]:
+    """Pure eligibility split over a scan's columns. `col_types` is the
+    source's decode_column_types() token map; `specs` the live input
+    specs (their key prefixes prove which dictionary-string columns are
+    consumed packed-only). Shared verbatim by the planner and the cost
+    model so prediction and execution can never disagree."""
+    from deequ_tpu.ops import native
+
+    consumers: Dict[str, set] = {}
+    for spec in specs.values():
+        prefix = spec.key.split(":", 1)[0]
+        for col in spec.columns or ():
+            consumers.setdefault(col, set()).add(prefix)
+    fast: List[str] = []
+    fallbacks: List[Tuple[str, str]] = []
+    for name in sorted(col_types):
+        token = col_types[name]
+        if token in native.DECODE_PRIMITIVES or token == "bool":
+            fast.append(name)
+        elif token == "dictionary<string,int32>":
+            unsafe = sorted(consumers.get(name, ()) - PACKED_SAFE_PREFIXES)
+            if unsafe:
+                fallbacks.append(
+                    (
+                        name,
+                        "host string values may be required by "
+                        + ", ".join(unsafe),
+                    )
+                )
+            else:
+                fast.append(name)
+        elif token in ("string", "large_string"):
+            fallbacks.append((name, "plain string values are host objects"))
+        elif token.startswith("timestamp"):
+            fallbacks.append((name, "timestamp decode needs an arrow cast"))
+        elif token.startswith("decimal"):
+            fallbacks.append((name, "decimal values decode host-side"))
+        else:
+            fallbacks.append((name, f"no native kernel for {token}"))
+    return fast, fallbacks
+
+
+def decode_saved_bytes_per_row(plan: DecodePlan, col_types: Dict[str, str]) -> int:
+    """Predicted bytes/row of intermediate materialization the fast
+    columns skip (value copy + mask byte-expansion)."""
+    return sum(
+        _DECODE_TOKEN_BYTES.get(col_types.get(c, ""), 0) + 1 for c in plan.fast
+    )
+
+
+def plan_decode_fastpath(table, specs: Dict[str, Any]):
+    """Build a DecodePlan for a parquet-backed scan, or None when the
+    knob is off, the source has no decode-planning surface, the native
+    library is unavailable, or anything at all goes wrong — the fast
+    path is an optimization, never a failure mode. Call AFTER column
+    pruning so only surviving columns are classified."""
+    if not runtime.decode_fastpath_enabled():
+        return None
+    types_fn = getattr(table, "decode_column_types", None)
+    if types_fn is None or getattr(table, "with_decode_fastpath", None) is None:
+        return None
+    from deequ_tpu.ops import native
+
+    if not native.available():
+        return None
+    try:
+        col_types = types_fn()
+        if not col_types:
+            return None
+        fast, fallbacks = classify_decode_columns(col_types, specs)
+        return DecodePlan(
+            fast=tuple(fast),
+            fallbacks=tuple(fallbacks),
+            workers=runtime.decode_workers(),
+        )
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def apply_decode_plan(table, plan: DecodePlan):
+    """Act on a DecodePlan: record the `decode_fastpath` span + counters
+    (the trace side of cost_drift's zero-drift pin and the
+    engine.decode_fastpath_ratio telemetry series), then view the source
+    with the fast set attached."""
+    with observe.span(
+        "decode_fastpath",
+        cat="plan",
+        cols_total=plan.total,
+        cols_fast=len(plan.fast),
+        cols_fallback=len(plan.fallbacks),
+        workers=plan.workers,
+    ):
+        pass
+    runtime.record_decode_fastpath(len(plan.fast), plan.total, plan.workers)
+    if plan.fast:
+        table = table.with_decode_fastpath(plan.fast)
+    return table
+
+
 def apply_prune_plan(table, prune, specs: Dict[str, Any]):
     """Act on a PrunePlan: swap every proven-all-true where's mask spec
     for a constant (the filter's columns then fall out of column
@@ -1172,6 +1315,12 @@ class FusedScanPass:
                 # constant-mask where's filter columns drop out of decode
                 table = apply_prune_plan(table, prune, specs)
             table = prune_table_columns(table, specs)
+            # decode routing comes last: it classifies exactly the
+            # columns that survived pruning (with_columns returns a new
+            # source, so the fast set must attach to the final view)
+            decode_plan = plan_decode_fastpath(table, specs)
+            if decode_plan is not None:
+                table = apply_decode_plan(table, decode_plan)
             merge_analyzers = [self.analyzers[i] for i in merge_idx]
             assisted = [self.analyzers[i] for i in assisted_idx]
             host_members = [(i, self.analyzers[i]) for i in host_idx]

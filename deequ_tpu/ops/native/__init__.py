@@ -44,7 +44,13 @@ def _traced_kernel(fn):
 
     return wrapper
 
-_SOURCE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "xxhash_hll.c")
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+#: every C translation unit compiled into the one native library; the
+#: cache digest covers all of them, so editing any source rebuilds
+_SOURCES = (
+    os.path.join(_PKG_DIR, "xxhash_hll.c"),
+    os.path.join(_PKG_DIR, "decode.c"),
+)
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
@@ -77,7 +83,7 @@ def per_user_cache_dir() -> Optional[str]:
 
 def _cache_dirs():
     """Candidate build dirs: the package itself, then the per-user cache."""
-    yield os.path.dirname(_SOURCE)
+    yield _PKG_DIR
     user_dir = per_user_cache_dir()
     if user_dir is not None:
         yield user_dir
@@ -111,8 +117,11 @@ def _build_library() -> Optional[str]:
     shadowed by) the plain one."""
     import hashlib
 
-    with open(_SOURCE, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    h = hashlib.sha256()
+    for source in _SOURCES:
+        with open(source, "rb") as f:
+            h.update(f.read())
+    digest = h.hexdigest()[:16]
     sanitize = _sanitize_flags()
     if sanitize:
         tag = hashlib.sha256(" ".join(sanitize).encode()).hexdigest()[:8]
@@ -129,7 +138,8 @@ def _build_library() -> Optional[str]:
                 subprocess.run(
                     [compiler, "-O3", "-shared", "-fPIC"]
                     + sanitize
-                    + [_SOURCE, "-o", tmp],
+                    + list(_SOURCES)
+                    + ["-o", tmp],
                     check=True,
                     capture_output=True,
                     timeout=120,
@@ -254,6 +264,50 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32),
         ]
         lib.masked_moments_select_multi.restype = ctypes.c_int
+        # decode.c: buffer-level Arrow decode fast path. Value/bitmap
+        # inputs arrive as raw addresses (c_void_p) so the wrapper can
+        # pass pre-advanced pointers without dtype-specific casts.
+        for name in (
+            "decode_f64",
+            "decode_f32",
+            "decode_i8",
+            "decode_i16",
+            "decode_i32",
+            "decode_i64",
+            "decode_u8",
+            "decode_u16",
+            "decode_u32",
+            "decode_u64",
+        ):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            fn.restype = ctypes.c_int64
+        lib.decode_bool.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.decode_bool.restype = ctypes.c_int64
+        lib.decode_dict_i32.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.decode_dict_i32.restype = ctypes.c_int64
         _LIB = lib
     except OSError:
         _LIB = None
@@ -660,6 +714,112 @@ def masked_moments_select_multi(
             )
         )
     return out
+
+
+#: arrow primitive type name -> (decode.c entry point, element bytes);
+#: the planner (ops/fused.py) and Table.from_arrow both key off this to
+#: decide fast-path eligibility, so the two can never disagree
+DECODE_PRIMITIVES = {
+    "double": ("decode_f64", 8),
+    "float": ("decode_f32", 4),
+    "int8": ("decode_i8", 1),
+    "int16": ("decode_i16", 2),
+    "int32": ("decode_i32", 4),
+    "int64": ("decode_i64", 8),
+    "uint8": ("decode_u8", 1),
+    "uint16": ("decode_u16", 2),
+    "uint32": ("decode_u32", 4),
+    "uint64": ("decode_u64", 8),
+}
+
+
+@_traced_kernel
+def decode_primitive(
+    kind: str,
+    values_addr: int,
+    validity_addr: Optional[int],
+    bit_offset: int,
+    n: int,
+    out_values: np.ndarray,
+    out_valid: np.ndarray,
+) -> Optional[int]:
+    """One-pass Arrow-buffer decode of a numeric chunk into the engine's
+    Column backing (neutral-fill values + bool mask; floats fold NaN into
+    the mask). `values_addr` is pre-advanced to the chunk's first logical
+    element; `validity_addr` is the raw bitmap buffer (row i's bit at
+    bit_offset + i) or None for null-free chunks. Writes `n` rows into
+    the (possibly offset) output views and returns the invalid-row
+    count; None when the native library is unavailable."""
+    lib = _load()
+    if lib is None or kind not in DECODE_PRIMITIVES:
+        return None
+    fn = getattr(lib, DECODE_PRIMITIVES[kind][0])
+    return int(
+        fn(
+            ctypes.c_void_p(values_addr),
+            ctypes.c_void_p(validity_addr) if validity_addr else None,
+            int(bit_offset),
+            int(n),
+            out_values.ctypes.data_as(ctypes.c_void_p),
+            out_valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+    )
+
+
+@_traced_kernel
+def decode_bool_bitmap(
+    values_addr: int,
+    value_bit_offset: int,
+    validity_addr: Optional[int],
+    valid_bit_offset: int,
+    n: int,
+    out_values: np.ndarray,
+    out_valid: np.ndarray,
+) -> Optional[int]:
+    """Arrow boolean chunk (values ARE a bitmap) -> bool values + mask
+    in one pass (null -> False). Returns the invalid-row count; None
+    when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    return int(
+        lib.decode_bool(
+            ctypes.c_void_p(values_addr),
+            int(value_bit_offset),
+            ctypes.c_void_p(validity_addr) if validity_addr else None,
+            int(valid_bit_offset),
+            int(n),
+            out_values.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            out_valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+    )
+
+
+@_traced_kernel
+def decode_dict_codes(
+    indices_addr: int,
+    validity_addr: Optional[int],
+    bit_offset: int,
+    n: int,
+    out_codes: np.ndarray,
+    out_valid: np.ndarray,
+) -> Optional[int]:
+    """Dictionary-column int32 index buffer -> dict_encode codes
+    (null -> -1) + mask in one pass. Returns the invalid-row count;
+    None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    return int(
+        lib.decode_dict_i32(
+            ctypes.c_void_p(indices_addr),
+            ctypes.c_void_p(validity_addr) if validity_addr else None,
+            int(bit_offset),
+            int(n),
+            out_codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out_valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+    )
 
 
 @_traced_kernel

@@ -215,6 +215,39 @@ def pushdown_enabled() -> bool:
     return os.environ.get("DEEQU_TPU_PUSHDOWN", "") not in ("0", "off")
 
 
+def decode_fastpath_enabled() -> bool:
+    """Whether parquet decode may route planner-approved columns through
+    the buffer-level native kernels (ops/native/decode.c) instead of the
+    host from_arrow chain.
+
+    `DEEQU_TPU_DECODE_FASTPATH=0` (or `off`) forces every column through
+    the host chain — the baseline the decode differential suite compares
+    against. Both paths emit bit-identical Columns, so this knob only
+    moves decode time, never results."""
+    import os
+
+    return os.environ.get("DEEQU_TPU_DECODE_FASTPATH", "") not in ("0", "off")
+
+
+def decode_workers() -> int:
+    """Number of parallel row-group decode workers
+    (`DEEQU_TPU_DECODE_WORKERS`, default `min(cores, 4)`; 1 = the
+    single decode thread the pipeline always had). pyarrow and the
+    native decode kernels release the GIL, so workers scale decode on
+    multi-core boxes; the merge back into the pipeline is in submission
+    order, so results are bit-identical at any worker count."""
+    import os
+
+    raw = os.environ.get("DEEQU_TPU_DECODE_WORKERS", "")
+    try:
+        workers = int(raw)
+    except ValueError:
+        workers = 0
+    if workers < 1:
+        workers = min(os.cpu_count() or 1, 4)
+    return workers
+
+
 def pipeline_depth() -> int:
     """Bounded inter-stage queue depth (`DEEQU_TPU_PIPELINE_DEPTH`,
     default 2): at most this many prepped batches — packed wire buffers
@@ -401,6 +434,10 @@ def record_group_pass(label: str) -> None:
 
 def record_pruned_groups(skipped: int, total: int) -> None:
     _counters.record_pruned_groups(skipped, total)
+
+
+def record_decode_fastpath(fast: int, total: int, workers: int) -> None:
+    _counters.record_decode_fastpath(fast, total, workers)
 
 
 def pad_to(arr: np.ndarray, size: int) -> np.ndarray:

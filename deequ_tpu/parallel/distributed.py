@@ -36,8 +36,10 @@ from deequ_tpu.ops.fused import (
     PipelinedAggFold,
     _pad_size,
     _precompute_family_kernels,
+    apply_decode_plan,
     fold_host_batch,
     materialize_host_results,
+    plan_decode_fastpath,
     plan_scan_members,
     prune_table_columns,
     resolve_shift,
@@ -191,6 +193,12 @@ class DistributedScanPass:
         device_keys = plan.device_keys
 
         table = prune_table_columns(table, specs)
+        # decode routing after pruning, exactly as in FusedScanPass: the
+        # mesh shards the packed wire arrays, so whether a column decoded
+        # through the native kernels or the host chain is invisible to it
+        decode_plan = plan_decode_fastpath(table, specs)
+        if decode_plan is not None:
+            table = apply_decode_plan(table, decode_plan)
         n_devices = self.mesh.shape[self.axis_name]
         global_batch = self.batch_size_per_device * n_devices
         dtype = runtime.compute_dtype()

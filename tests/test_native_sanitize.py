@@ -84,6 +84,46 @@ packed = native.xxhash64_pack(vals, np.ones(n, dtype=bool))
 assert packed is not None and packed.shape == (n,)
 counts = native.bincount(vals.astype(np.int64), 1000)
 assert counts is not None and counts.sum() == n
+
+# decode kernels on SLICED arrays: slice offsets put the validity scan
+# at a non-byte-aligned bit position and the row count ends mid-byte,
+# the exact shapes where an off-by-one reads past the bitmap
+import pyarrow as pa
+
+f = pa.array([float(i) if i % 7 else None for i in range(1001)]).slice(3, 900)
+out_v = np.empty(len(f), dtype=np.float64)
+out_m = np.empty(len(f), dtype=np.bool_)
+bufs = f.buffers()
+rc = native.decode_primitive(
+    "double", bufs[1].address + f.offset * 8, bufs[0].address,
+    f.offset, len(f), out_v, out_m,
+)
+assert rc == sum(v is None for v in f.to_pylist())
+assert [v if m else None for v, m in zip(out_v, out_m)] == f.to_pylist()
+
+b = pa.array([bool(i % 3) if i % 5 else None for i in range(997)]).slice(6, 901)
+out_b = np.empty(len(b), dtype=np.bool_)
+out_bm = np.empty(len(b), dtype=np.bool_)
+bb = b.buffers()
+rc = native.decode_bool_bitmap(
+    bb[1].address, b.offset, bb[0].address, b.offset, len(b), out_b, out_bm
+)
+assert rc == sum(v is None for v in b.to_pylist())
+assert [bool(v) if m else None for v, m in zip(out_b, out_bm)] == b.to_pylist()
+
+d = pa.array(
+    ["abc", None, "de", "abc", "f"] * 201
+).dictionary_encode().slice(2, 1000)
+idx = d.indices
+out_c = np.empty(len(idx), dtype=np.int32)
+out_cm = np.empty(len(idx), dtype=np.bool_)
+ib = idx.buffers()
+rc = native.decode_dict_codes(
+    ib[1].address + idx.offset * 4, ib[0].address, idx.offset,
+    len(idx), out_c, out_cm,
+)
+assert rc == d.null_count
+assert all(c == -1 for c, m in zip(out_c, out_cm) if not m)
 print("SANITIZED_OK")
 """
 
@@ -174,6 +214,15 @@ shared_x = rng.random(n)
 shared_valid = rng.random(n) > 0.05
 shared_where = rng.random(n) > 0.3
 
+# one shared sliced arrow chunk decoded by every thread — the decode
+# worker pool's shape (threads share the arrow buffers, write disjoint
+# outputs)
+import pyarrow as pa
+shared_arrow = pa.array(
+    [float(i) if i % 9 else None for i in range(n + 11)]
+).slice(5, n)
+_ab = shared_arrow.buffers()
+
 def work(seed):
     r = np.random.default_rng(seed)
     x = r.random(n)
@@ -194,6 +243,13 @@ def work(seed):
         assert packed is not None
         counts = native.bincount(vals.astype(np.int64), 500)
         assert counts is not None and counts.sum() == n
+        dv = np.empty(len(shared_arrow), dtype=np.float64)
+        dm = np.empty(len(shared_arrow), dtype=np.bool_)
+        rc = native.decode_primitive(
+            "double", _ab[1].address + shared_arrow.offset * 8,
+            _ab[0].address, shared_arrow.offset, len(shared_arrow), dv, dm,
+        )
+        assert rc == shared_arrow.null_count
     # deterministic reference: same shared inputs -> same moments
     mom = native.masked_moments_select(
         shared_x, shared_valid, shared_where, cap=128

@@ -431,3 +431,74 @@ def test_globalmut_reads_are_not_findings():
     finally:
         os.unlink(path)
     assert findings == []
+
+
+def test_decode_checker_flags_to_numpy_outside_fallback():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def decode_fast_column(arr):\n"
+        "    return arr.to_numpy(zero_copy_only=False)\n"
+    )
+    try:
+        findings = lint.check_decode_copies(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+    assert "DECODE" in findings[0] and "to_numpy" in findings[0]
+
+
+def test_decode_checker_flags_frombuffer_copy_idiom():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import numpy as np\n"
+        "def decode_fast_column(buf):\n"
+        "    return np.frombuffer(buf, dtype=np.int64)\n"
+    )
+    try:
+        findings = lint.check_decode_copies(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+    assert "frombuffer" in findings[0]
+
+
+def test_decode_checker_allows_designated_fallback_functions():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import numpy as np\n"
+        "def dictionary_uniques_fallback(dictionary):\n"
+        "    return dictionary.to_numpy(zero_copy_only=False)\n"
+        "def column_fallback(arr):\n"
+        "    def inner(b):\n"
+        "        return np.frombuffer(b, dtype=np.uint8)\n"
+        "    return inner(arr)\n"
+    )
+    try:
+        findings = lint.check_decode_copies(path)
+    finally:
+        os.unlink(path)
+    assert findings == []
+
+
+def test_decode_checker_allows_buffer_level_code():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import numpy as np\n"
+        "def decode(ch, native, out_vals, out_valid):\n"
+        "    bufs = ch.buffers()\n"
+        "    return native.decode_primitive(\n"
+        "        'double', bufs[1].address, None, ch.offset, len(ch),\n"
+        "        out_vals, out_valid)\n"
+    )
+    try:
+        findings = lint.check_decode_copies(path)
+    finally:
+        os.unlink(path)
+    assert findings == []
+
+
+def test_decode_rule_covers_the_fastpath_modules():
+    lint = _lint_module()
+    sep = os.sep
+    assert f"deequ_tpu{sep}data{sep}arrow_decode.py" in lint.DECODE_FILES
+    assert f"deequ_tpu{sep}ops{sep}native{sep}__init__.py" in lint.DECODE_FILES

@@ -611,6 +611,71 @@ def test_pushdown_on_off_bit_identical(seed, monkeypatch, tmp_path):
         )
 
 
+# -- decode fast path + workers on/off differential (ISSUE 8) ----------------
+
+
+@pytest.mark.parametrize(
+    "layout,seed",
+    [(layout, seed) for layout in ("narrow", "wide", "lineitem") for seed in range(3)],
+)
+def test_decode_fastpath_workers_bit_identical(layout, seed, monkeypatch, tmp_path):
+    """DEEQU_TPU_DECODE_FASTPATH=0 (the host from_arrow chain) and
+    DEEQU_TPU_DECODE_WORKERS at 1 vs 3 must all be BIT-identical —
+    exact snapshot equality, sketches included: the fast path and the
+    worker pool change WHERE and HOW columns decode, never one bit of
+    any value, mask, or dictionary code. Runs every layout so numeric
+    primitives, bool bitmaps, dictionary codes, NaN folds and the
+    tiny-group coalescer all cross both decode routes. Also pins that
+    under a tracer the decode planner actually engaged (decode_fastpath
+    span with fast columns) and the worker pool actually fanned out
+    (decode_unit spans)."""
+    from deequ_tpu import observe
+    from deequ_tpu.data.table import Table as TableCls
+
+    rng = np.random.default_rng(14_000 + seed)
+    table = LAYOUTS[layout](rng)
+    n = table.num_rows
+    roles = layout_roles(layout, rng)
+    checks = [random_check(rng, roles) for _ in range(int(rng.integers(1, 3)))]
+    placement = "device" if seed % 2 else "host"
+
+    path = str(tmp_path / "decode.parquet")
+    table.to_parquet(
+        path, row_group_size=max(64, n // 7), dictionary_encode_strings=True
+    )
+
+    def run(fastpath_env, workers_env):
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+        monkeypatch.setenv("DEEQU_TPU_DECODE_FASTPATH", fastpath_env)
+        monkeypatch.setenv("DEEQU_TPU_DECODE_WORKERS", workers_env)
+        data = TableCls.scan_parquet(path, batch_rows=max(64, n // 5))
+        builder = VerificationSuite().on_data(data)
+        for check in checks:
+            builder = builder.add_check(check)
+        return suite_snapshot(builder.with_engine("single").run())
+
+    baseline = run("0", "1")
+    for fp, workers in (("1", "1"), ("0", "3"), ("1", "3")):
+        assert run(fp, workers) == baseline, (layout, seed, fp, workers)
+
+    with observe.tracing() as tracer:
+        traced = run("1", "3")
+    assert traced == baseline, ("tracing changed results", layout, seed)
+    spans = [
+        sp for root in tracer.roots for sp in _iter_spans(root)
+    ]
+    plans = [sp for sp in spans if sp.name == "decode_fastpath"]
+    assert plans, "decode planner never produced a plan"
+    assert all(sp.attrs["workers"] == 3 for sp in plans)
+    assert sum(sp.attrs["cols_fast"] for sp in plans) > 0, (
+        "no column took the fast path",
+        layout,
+    )
+    assert any(sp.name == "decode_unit" for sp in spans), (
+        "parallel decode workers never engaged"
+    )
+
+
 @pytest.mark.parametrize(
     "layout,seed",
     [("wide", 0), ("wide", 1), ("lineitem", 0), ("lineitem", 1)],

@@ -38,6 +38,13 @@ Checks:
              is the single reader. Purity keeps every verdict unit-
              testable without files and the lint layer importable
              without pyarrow.
+  DECODE   — the fast-path decode modules (data/arrow_decode.py,
+             ops/native/) must stay buffer-level: no `.to_numpy(...)`
+             and no `frombuffer(...)` copy idioms outside designated
+             fallback functions (names ending `_fallback`). The fast
+             path's whole point is ONE native pass from arrow buffers
+             to Column backing; a host-copy idiom silently reintroduces
+             the intermediate materialization it exists to remove.
   F401*    — unused imports (fallback when ruff is unavailable).
   E722*    — bare `except:` (fallback when ruff is unavailable).
 
@@ -89,6 +96,13 @@ OBSPRINT_DIRS = (os.path.join("deequ_tpu", "observe"),)
 # Pure-interpreter files: no pyarrow/pandas imports, no open() calls.
 PUSHDOWN_FILES = [os.path.join("deequ_tpu", "lint", "pushdown.py")]
 PUSHDOWN_FORBIDDEN_MODULES = {"pyarrow", "pandas"}
+# Fast-path decode modules: buffer-level only, no host-copy idioms
+# outside designated fallback functions (names ending `_fallback`).
+DECODE_FILES = [
+    os.path.join("deequ_tpu", "data", "arrow_decode.py"),
+    os.path.join("deequ_tpu", "ops", "native", "__init__.py"),
+]
+DECODE_FORBIDDEN_ATTRS = {"to_numpy", "frombuffer"}
 GLOBALMUT_MUTATORS = {
     "append",
     "extend",
@@ -272,6 +286,42 @@ def check_pushdown_purity(path: str) -> List[str]:
                 f"stats interpreter — it must never touch files; pass "
                 f"RowGroupStats in"
             )
+    return findings
+
+
+# -- DECODE: no host-copy idioms in fast-path decode modules -----------------
+
+
+def check_decode_copies(path: str) -> List[str]:
+    """Flag `.to_numpy(...)` / `.frombuffer(...)` calls in the fast-path
+    decode modules outside designated fallback functions (any enclosing
+    function whose name ends `_fallback`). The fast path exists to
+    replace exactly these per-column host copies with one native pass
+    over the arrow buffers; host materialization belongs in the
+    designated fallbacks (e.g. table.py's _column_from_arrow_fallback)."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    findings: List[str] = []
+
+    def walk(node: ast.AST, in_fallback: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            in_fallback = in_fallback or node.name.endswith("_fallback")
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in DECODE_FORBIDDEN_ATTRS
+            and not in_fallback
+        ):
+            findings.append(
+                f"{_rel(path)}:{node.lineno}: DECODE `.{node.func.attr}(...)` "
+                f"in a fast-path decode module — this is the host copy the "
+                f"fast path removes; decode via the native kernels, or move "
+                f"the copy into a designated `*_fallback` function"
+            )
+        for child in ast.iter_child_nodes(node):
+            walk(child, in_fallback)
+
+    walk(tree, False)
     return findings
 
 
@@ -549,6 +599,11 @@ def main() -> int:
         path = os.path.join(REPO, rel)
         if os.path.exists(path):
             findings.extend(check_pushdown_purity(path))
+
+    for rel in DECODE_FILES:
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):
+            findings.extend(check_decode_copies(path))
 
     for path in _python_files():
         rel = _rel(path)

@@ -49,6 +49,14 @@ WATCHED_SERIES: Sequence[Tuple[str, str]] = (
     # statically; a drop means predicates stopped proving groups
     # all-false (stats regressed, interpreter weakened, plan changed)
     ("engine.rg_skipped_ratio", "down"),
+    # decode fast-path effectiveness: the fraction of scanned columns on
+    # the buffer-level native decode; a drop means columns fell back to
+    # the host chain (classifier narrowed, native build broken, schema
+    # drifted toward ineligible types)
+    ("engine.decode_fastpath_ratio", "down"),
+    # per-scan decode worker count; a drop means the pool stopped
+    # scaling (env override lost, cpu_count misdetected)
+    ("engine.decode_workers", "down"),
 )
 
 #: phases whose share of wall time is watched (rises are bad: a phase
