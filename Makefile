@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint typecheck analyze sentinel test test-fast trace-demo bench-pushdown bench-decode
+.PHONY: lint typecheck analyze sentinel test test-fast trace-demo bench-pushdown bench-decode bench-wire
 
 lint:
 	$(PY) tools/lint.py
@@ -47,6 +47,15 @@ bench-pushdown:
 BENCH_DECODE_ROWS ?= 4000000
 bench-decode:
 	JAX_PLATFORMS=cpu BENCH_MODE=decode BENCH_ROWS=$(BENCH_DECODE_ROWS) $(PY) bench.py
+
+# decode-to-wire fusion A/B over the same 50-column wide stream shape:
+# same packed-wire-safe plan with DEEQU_TPU_WIRE_FUSED=0 then =1,
+# bit-identity asserted, decode+prep combined self-seconds from traced
+# warm passes plus warm-jit cold-IO wall times. Refreshes
+# BENCH_WIRE.json (methodology: BENCH.md round 10)
+BENCH_WIRE_ROWS ?= 4000000
+bench-wire:
+	JAX_PLATFORMS=cpu BENCH_MODE=wire BENCH_ROWS=$(BENCH_WIRE_ROWS) $(PY) bench.py
 
 test: lint
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q

@@ -839,6 +839,208 @@ def run_decode_bench(n_rows: int) -> None:
     print(json.dumps(rec))
 
 
+def _dispatch_self_s(roots) -> float:
+    """Prep self-seconds from a traced pass: the sum of the `dispatch`
+    spans (ops/fused.py), which wrap exactly the host wire pack
+    (`pack_batch_inputs`) + H2D put that decode-to-wire fusion moves
+    into the decode workers — device compute stays async outside."""
+    total = 0.0
+
+    def visit(span):
+        nonlocal total
+        if span.name == "dispatch":
+            total += span.duration_s
+        for child in span.children:
+            visit(child)
+
+    for root in roots:
+        visit(root)
+    return total
+
+
+def _occupancy_rows(roots):
+    """Stage occupancy rows for the BENCH.md re-baseline table."""
+    from deequ_tpu import observe
+
+    return [
+        {
+            "stage": row["stage"],
+            "busy_s": round(float(row["busy_s"]), 2),
+            "occupancy": round(float(row["occupancy"]), 3),
+        }
+        for row in observe.pipeline_occupancy(roots)
+    ]
+
+
+def run_wire_bench(n_rows: int) -> None:
+    """BENCH_MODE=wire: A/B decode-to-wire fusion (ISSUE 9) on the same
+    50-column wide-stream shape and packed-wire-safe plan as the decode
+    bench. DEEQU_TPU_WIRE_FUSED=0 decodes every column to a host Column
+    and packs the wire serially in the prep stage; =1 has the decode
+    workers emit packed wire slices directly and the prep pack splice
+    them in. Same discipline as the decode A/B: a traced warm-up (jit +
+    imports + the planner's wire verdict), one traced WARM pass per
+    side for decode/prep self-seconds and the stage-occupancy
+    re-baseline (traced passes are never the timed ones), then two
+    warm-jit cold-IO UNTRACED timed passes. The headline is the
+    decode+prep COMBINED self-time — fusion moves pack work between the
+    stages, so either stage alone would miscount. Aborts on any metric
+    mismatch. Refreshes BENCH_WIRE.json (round/config preserved)."""
+    import pyarrow.parquet as pq
+
+    from deequ_tpu import observe
+    from deequ_tpu.data.table import Table
+    from deequ_tpu.ops.fused import FusedScanPass
+
+    path = os.environ.get("BENCH_PARQUET", "/tmp/bench_decode.parquet")
+    t_gen = time.perf_counter()
+    if not (
+        os.path.exists(path) and pq.ParquetFile(path).metadata.num_rows == n_rows
+    ):
+        write_decode_parquet(n_rows, path)
+    gen_s = time.perf_counter() - t_gen
+
+    analyzers = decode_analyzers()
+    # the wire verdict needs packed-only consumers, i.e. device members
+    os.environ["DEEQU_TPU_PLACEMENT"] = "device"
+    workers_n = min(os.cpu_count() or 1, 4)
+    os.environ["DEEQU_TPU_DECODE_WORKERS"] = str(workers_n)
+
+    def run_once():
+        snapshot = {}
+        for r in FusedScanPass(analyzers).run(
+            Table.scan_parquet(path, batch_rows=1 << 20)
+        ):
+            value = r.analyzer.compute_metric_from(r.state_or_raise()).value
+            v = (
+                value.get()
+                if value.is_success
+                else type(value.exception).__name__
+            )
+            if isinstance(v, float) and v != v:
+                v = "nan"  # nan != nan would defeat the A/B comparison
+            snapshot[repr(r.analyzer)] = v
+        return snapshot
+
+    # warm-up FIRST (traced, fusion ON): compiles every program, pays
+    # the one-time imports, and its decode_fastpath span carries the
+    # planner's wire verdict
+    os.environ["DEEQU_TPU_WIRE_FUSED"] = "1"
+    with observe.tracing() as tracer_warm:
+        warm_snapshot = run_once()
+    plan = {"cols_total": 0, "cols_fast": 0, "cols_wire_fused": 0}
+
+    def visit(span):
+        if span.name == "decode_fastpath":
+            for key in plan:
+                plan[key] = max(plan[key], int(span.attrs.get(key, 0)))
+        for child in span.children:
+            visit(child)
+
+    for root in tracer_warm.roots:
+        visit(root)
+
+    # decode+prep self-seconds per side from one traced WARM pass each
+    # (jit and page cache hot, so the delta isolates the moved pack)
+    os.environ["DEEQU_TPU_WIRE_FUSED"] = "0"
+    with observe.tracing() as tracer_off:
+        off_traced_snapshot = run_once()
+    os.environ["DEEQU_TPU_WIRE_FUSED"] = "1"
+    with observe.tracing() as tracer_on:
+        on_traced_snapshot = run_once()
+    decode_s_off = _arrow_decode_self_s(tracer_off.roots)
+    decode_s_on = _arrow_decode_self_s(tracer_on.roots)
+    prep_s_off = _dispatch_self_s(tracer_off.roots)
+    prep_s_on = _dispatch_self_s(tracer_on.roots)
+    combined_off = decode_s_off + prep_s_off
+    combined_on = decode_s_on + prep_s_on
+    occupancy_off = _occupancy_rows(tracer_off.roots)
+    occupancy_on = _occupancy_rows(tracer_on.roots)
+
+    # warm-jit cold-IO wall times, untraced, page cache dropped
+    os.environ["DEEQU_TPU_WIRE_FUSED"] = "0"
+    cache_dropped = _drop_page_cache()
+    t0 = time.perf_counter()
+    off_snapshot = run_once()
+    off_s = time.perf_counter() - t0
+
+    os.environ["DEEQU_TPU_WIRE_FUSED"] = "1"
+    _drop_page_cache()
+    t0 = time.perf_counter()
+    on_snapshot = run_once()
+    on_s = time.perf_counter() - t0
+
+    if not (
+        warm_snapshot == off_traced_snapshot == on_traced_snapshot
+        == off_snapshot == on_snapshot
+    ):
+        raise SystemExit(
+            "wire A/B: metric mismatch between the fused and Column "
+            f"sides\noff: {off_snapshot}\non:  {on_snapshot}"
+        )
+
+    reduction = (
+        100.0 * (combined_off - combined_on) / combined_off
+        if combined_off > 0
+        else 0.0
+    )
+    rec = {
+        "metric": "wire_rows_per_sec_per_chip",
+        "value": round(n_rows / on_s, 1),
+        "unit": "rows/s",
+        "rows": n_rows,
+        "columns": plan["cols_total"],
+        "wire_ab": {
+            "off_s": round(off_s, 2),
+            "on_s": round(on_s, 2),
+            "speedup_pct": round(100.0 * (off_s - on_s) / off_s, 1),
+            "decode_s_off": round(decode_s_off, 2),
+            "decode_s_on": round(decode_s_on, 2),
+            "prep_s_off": round(prep_s_off, 2),
+            "prep_s_on": round(prep_s_on, 2),
+            "combined_s_off": round(combined_off, 2),
+            "combined_s_on": round(combined_on, 2),
+            "combined_reduction_pct": round(reduction, 1),
+            "occupancy_off": occupancy_off,
+            "occupancy_on": occupancy_on,
+            "cols_wire_fused": plan["cols_wire_fused"],
+            "cols_fast": plan["cols_fast"],
+            "cols_total": plan["cols_total"],
+            "workers_n": workers_n,
+            "bit_identical": True,
+            "page_cache_dropped": cache_dropped,
+            "passes": (
+                "traced warm-up (on) for the wire verdict + one traced "
+                "warm pass per side for decode/prep self-seconds and "
+                "stage occupancy; both timed passes are warm-jit, "
+                "cold-IO, untraced"
+            ),
+        },
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "BENCH_WIRE.json")
+    try:
+        with open(out_path) as fh:
+            old = json.load(fh)
+        for key in ("round", "config"):
+            if key in old and key not in rec:
+                rec[key] = old[key]
+    except Exception:  # noqa: BLE001 - first write: no fields to carry
+        pass
+    with open(out_path, "w") as fh:
+        json.dump(rec, fh)
+        fh.write("\n")
+    print(
+        f"# bench: wire A/B off={off_s:.2f}s on={on_s:.2f}s "
+        f"(+{100.0 * (off_s - on_s) / off_s:.1f}%), decode+prep self "
+        f"{combined_off:.2f}s -> {combined_on:.2f}s (-{reduction:.1f}%), "
+        f"{plan['cols_wire_fused']}/{plan['cols_total']} cols fused; "
+        f"gen={gen_s:.1f}s",
+        file=sys.stderr,
+    )
+    print(json.dumps(rec))
+
+
 def _stream_shape() -> str:
     return os.environ.get("BENCH_STREAM_SHAPE", "default")
 
@@ -1177,6 +1379,11 @@ def main() -> None:
     if mode == "decode":
         # self-contained A/B with its own JSON record and artifact
         run_decode_bench(n_rows)
+        return
+
+    if mode == "wire":
+        # self-contained A/B with its own JSON record and artifact
+        run_wire_bench(n_rows)
         return
 
     t_gen = time.perf_counter()

@@ -29,12 +29,13 @@ from deequ_tpu.data.table import (
     ColumnType,
     _arrow_dictionary_digest,
     _arrow_logical_decimal,
+    _column_from_arrow_fallback,
     dictionary_uniques_fallback,
     gather_with_null,
     pool_empty,
     shared_all_true,
 )
-from deequ_tpu.ops import native
+from deequ_tpu.ops import native, runtime
 
 
 def decode_fast_column(
@@ -137,6 +138,178 @@ def _decode_boolean(name, chunks, shared):
         pos += len(ch)
     valid = shared_all_true(shared, n) if invalid == 0 else out_valid
     return Column(name, ColumnType.BOOLEAN, out_vals, valid)
+
+
+def _wire_stub_valid_fallback(bits: np.ndarray, n: int) -> np.ndarray:
+    """Designated fallback: expand a wire bitmask (MSB-first packed, one
+    bit per row) back into the Column uint8-bool mask. Only runs when a
+    consumer outside the planned packed set touches a fused column's
+    `.valid` — never in the steady-state wire path."""
+    return np.unpackbits(bits[: (n + 7) // 8], count=n).astype(np.bool_)
+
+
+def _wire_stub_column_fallback(name, chunks, arrow_table):
+    """Designated fallback: rebuild the full engine Column for a
+    wire-fused column from its retained arrow chunks. Exact same decode
+    the column would have taken without fusion (native fast path first,
+    host chain second), so values/valid are bit-identical."""
+    import pyarrow as pa
+
+    shared: Dict[str, np.ndarray] = {}
+    col = decode_fast_column(name, chunks, arrow_table, shared)
+    if col is not None:
+        return col
+    if len(chunks) == 1:
+        arr = chunks[0]
+    elif not chunks:
+        arr = pa.array([], type=pa.float64())
+    else:
+        arr = pa.chunked_array(chunks).combine_chunks()
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.chunk(0)
+    return _column_from_arrow_fallback(name, arr, arrow_table, shared)
+
+
+class WireStubColumn(Column):
+    """Stand-in Column for a decode-to-wire fused column.
+
+    The wire buffers already hold everything the planned consumers need,
+    so in the steady state nothing ever reads this column's host
+    backing. Both accessors stay lazy and exact anyway: `.valid`
+    expands the wire bitmask, `.values` re-decodes the retained arrow
+    chunks through the ordinary path — so an unplanned consumer (debug
+    hook, REPL poke) sees bit-identical data, just slower."""
+
+    def __init__(self, name, ctype, n, chunks, arrow_table, wire_bits):
+        self._wire_n = int(n)
+        self._wire_bits = wire_bits  # None for value-only fusion
+        self._wire_chunks = chunks
+        self._wire_arrow = arrow_table
+        super().__init__(name, ctype, self._wire_rebuild_values, None)
+
+    def __len__(self) -> int:
+        # Column.__len__ reads len(self.valid); that would materialize
+        # the mask on every batch just to size-check the table
+        return self._wire_n
+
+    def _wire_rebuild_values(self):
+        col = _wire_stub_column_fallback(
+            self.name, self._wire_chunks, self._wire_arrow
+        )
+        if self._valid_arr is None:
+            self._valid_arr = np.asarray(col.valid)
+        return col.values
+
+    @property
+    def valid(self):
+        if self._valid_arr is None:
+            if self._wire_bits is not None:
+                self._valid_arr = _wire_stub_valid_fallback(
+                    self._wire_bits, self._wire_n
+                )
+            else:
+                col = _wire_stub_column_fallback(
+                    self.name, self._wire_chunks, self._wire_arrow
+                )
+                self._valid_arr = np.asarray(col.valid)
+        return self._valid_arr
+
+    @valid.setter
+    def valid(self, value):
+        self._valid_arr = value
+
+
+def decode_wire_column(name, chunks, arrow_table, spec, wire):
+    """Decode one column's chunks straight to wire buffers.
+
+    Returns ``(column_stub, {wire_key: WireRow})`` on success or None to
+    route the column back through the ordinary decode (this batch only —
+    the planner's verdict stands and the next batch retries). The wire
+    kernels write each chunk at its running row offset, so row groups
+    that end off a multiple of 8 continue mid-byte in the shared
+    bitmask (OR-only writes keep boundary bytes safe across workers).
+
+    Failure modes that fall back per-batch: unexpected chunk layout,
+    narrowed-int overflow against the pinned width (kernel returns -1),
+    and an f32 shift not yet published by the pack thread."""
+    import pyarrow as pa
+
+    if not chunks or not native.available():
+        return None
+    token = str(chunks[0].type)
+    if token != spec.token or any(str(c.type) != token for c in chunks):
+        return None
+    n = sum(len(c) for c in chunks)
+    if n == 0:
+        return None
+    shift = 0.0
+    if spec.needs_shift:
+        resolved = wire.shift_for(f"num:{name}")
+        if resolved is None:
+            return None
+        shift = resolved
+    padded = runtime.wire_pad_size(n, wire.batch_size)
+    # np.zeros, not pool_empty: the pad tail must be zero to match the
+    # zeroed group buffer pack_batch_inputs would have built, and the
+    # bitmask is OR-only so every byte must start cleared
+    bits = np.zeros(padded // 8, dtype=np.uint8) if spec.want_valid else None
+    vals = (
+        np.zeros(padded, dtype=np.dtype(spec.value_dtype))
+        if spec.want_value
+        else None
+    )
+    is_float = token in ("double", "float")
+    invalid = 0
+    pos = 0
+    for ch in chunks:
+        m = len(ch)
+        if m == 0:
+            continue
+        if spec.want_value or is_float:
+            bufs = ch.buffers()
+            if len(bufs) != 2 or bufs[1] is None:
+                return None
+            itemsize = native.DECODE_PRIMITIVES[token][1]
+            rc = native.wire_primitive(
+                token,
+                bufs[1].address + ch.offset * itemsize,
+                _validity_addr(ch),
+                ch.offset,
+                m,
+                shift,
+                vals[pos:] if vals is not None else None,
+                bits,
+                pos,
+            )
+        else:
+            # int/bool valid-only fusion: no value row, bitmask direct
+            # from the validity bitmap (no NaN fold for these types)
+            rc = native.wire_valid_bits(_validity_addr(ch), ch.offset, m, bits, pos)
+        if rc is None:
+            return None
+        invalid += rc
+        pos += m
+    rows: Dict[str, runtime.WireRow] = {}
+    if spec.want_value:
+        rows[f"num:{name}"] = runtime.WireRow(
+            kind=spec.value_kind, arr=vals, shift=shift
+        )
+    if spec.want_valid:
+        rows[f"valid:{name}"] = runtime.WireRow(
+            kind="bits", arr=bits, all_valid=(invalid == 0)
+        )
+    if token == "bool":
+        ctype = ColumnType.BOOLEAN
+    elif is_float:
+        ctype = (
+            ColumnType.DECIMAL
+            if _arrow_logical_decimal(arrow_table, name)
+            else ColumnType.DOUBLE
+        )
+    else:
+        ctype = ColumnType.LONG
+    stub = WireStubColumn(name, ctype, n, list(chunks), arrow_table, bits)
+    return stub, rows
 
 
 def _decode_dictionary(name, chunks, shared):

@@ -51,18 +51,25 @@ def _arrow_ctype(t) -> ColumnType:
     return ColumnType.STRING
 
 
-def _decode_table(arrow_table, fastpath) -> Table:
+def _decode_table(arrow_table, fastpath, wire=None) -> Table:
     """Arrow batch -> engine Table under an `arrow_decode` span.
 
     The span isolates the buffer->wire conversion self-time from the
     parquet read/decompression that surrounds it in the decode stage,
     so traces (and BENCH_DECODE.json) report the exact seconds the
-    decode fast path targets."""
+    decode fast path targets. `wire_fuse` counts the columns this batch
+    decoded straight to wire buffers (decode-to-wire fusion)."""
     sp = _spans.span("arrow_decode", cat="decode")
     with sp:
-        table = Table.from_arrow(arrow_table, fastpath)
+        table = Table.from_arrow(arrow_table, fastpath, wire=wire)
         if sp:
-            sp.set(rows=int(table.num_rows), fast=bool(fastpath))
+            wire_rows = getattr(table, "wire_rows", None) or {}
+            fused_cols = {k.split(":", 1)[1] for k in wire_rows}
+            sp.set(
+                rows=int(table.num_rows),
+                fast=bool(fastpath),
+                wire_fuse=len(fused_cols),
+            )
     return table
 
 
@@ -270,6 +277,7 @@ class ParquetSource(DataSource):
         batch_rows: int = 1 << 22,
         prune_groups: Optional[Sequence[int]] = None,
         decode_fastpath: Optional[Sequence[str]] = None,
+        wire_fusion=None,
     ):
         import pyarrow.parquet as pq
 
@@ -287,6 +295,11 @@ class ParquetSource(DataSource):
         self.decode_fastpath = (
             frozenset(decode_fastpath) if decode_fastpath else None
         )
+        # decode-to-wire plan (runtime.WireFusionPlan) for the subset of
+        # fast-decode columns whose every consumer is packed-only: those
+        # skip the Column intermediate entirely. Shared by reference —
+        # the plan carries the pass's sticky-shift handshake.
+        self.wire_fusion = wire_fusion
         pf = pq.ParquetFile(path)
         meta = pf.metadata
         if self.prune_groups:
@@ -325,6 +338,7 @@ class ParquetSource(DataSource):
             batch_rows=self.batch_rows,
             prune_groups=self.prune_groups,
             decode_fastpath=self.decode_fastpath,
+            wire_fusion=self.wire_fusion,
         )
 
     def with_prune(self, skip) -> "ParquetSource":
@@ -343,6 +357,7 @@ class ParquetSource(DataSource):
             batch_rows=self.batch_rows,
             prune_groups=skip,
             decode_fastpath=self.decode_fastpath,
+            wire_fusion=self.wire_fusion,
         )
 
     def with_decode_fastpath(self, names) -> "ParquetSource":
@@ -359,7 +374,30 @@ class ParquetSource(DataSource):
             batch_rows=self.batch_rows,
             prune_groups=self.prune_groups,
             decode_fastpath=names,
+            wire_fusion=self.wire_fusion,
         )
+
+    def with_wire_fusion(self, plan) -> "ParquetSource":
+        """Decode-to-wire view: `plan` is the runtime.WireFusionPlan the
+        planner built for this pass's packed-only columns. Carried by
+        reference (it holds the sticky-shift handshake); composes freely
+        with the other with_* views."""
+        if plan is None or not plan.columns:
+            return self
+        return ParquetSource(
+            self.path,
+            columns=self.columns,
+            batch_rows=self.batch_rows,
+            prune_groups=self.prune_groups,
+            decode_fastpath=self.decode_fastpath,
+            wire_fusion=plan,
+        )
+
+    @property
+    def wire_plan(self):
+        """The attached WireFusionPlan (None when not planned) — the
+        handle the fused pass uses for the shift publish handshake."""
+        return self.wire_fusion
 
     def decode_column_types(self):
         """Arrow type tokens per scanned column AS THE SCAN DECODES THEM
@@ -448,6 +486,22 @@ class ParquetSource(DataSource):
             return self.decode_fastpath
         return None
 
+    def _wire_fusion_active(self):
+        """The attached WireFusionPlan when the kill switch allows it.
+        Wire fusion rides on the native fast path, so both knobs gate
+        it — DEEQU_TPU_WIRE_FUSED=0 (or fastpath off) restores the
+        exact pre-fusion decode for the differential baseline."""
+        from deequ_tpu.ops import runtime
+
+        if (
+            self.wire_fusion is not None
+            and self.wire_fusion.columns
+            and runtime.wire_fused_enabled()
+            and runtime.decode_fastpath_enabled()
+        ):
+            return self.wire_fusion
+        return None
+
     def _iter_tables(self, batch_size: int) -> Iterator[Table]:
         from deequ_tpu.ops import runtime
 
@@ -463,6 +517,7 @@ class ParquetSource(DataSource):
         from deequ_tpu.ops import runtime
 
         fastpath = self._decode_fastpath_set()
+        wire = self._wire_fusion_active()
         size = min(batch_size, self.batch_rows)
         # Read row group by row group: this pyarrow's iter_batches /
         # dataset scanner retain every decoded batch in the pool for the
@@ -530,14 +585,14 @@ class ParquetSource(DataSource):
                     head = flush()
                     pending_rows = 0
                     for start in range(0, head.num_rows, size):
-                        yield _decode_table(head.slice(start, size), fastpath)
+                        yield _decode_table(head.slice(start, size), fastpath, wire)
                 for start in range(0, group.num_rows, size):
-                    yield _decode_table(group.slice(start, size), fastpath)
+                    yield _decode_table(group.slice(start, size), fastpath, wire)
                 del group
             tail = flush()
             if tail is not None:
                 for start in range(0, tail.num_rows, size):
-                    yield _decode_table(tail.slice(start, size), fastpath)
+                    yield _decode_table(tail.slice(start, size), fastpath, wire)
 
     def _plan_decode_units(self, size: int) -> List[Tuple[int, ...]]:
         """Replay the serial loop's coalescing decisions from metadata
@@ -603,6 +658,7 @@ class ParquetSource(DataSource):
         from deequ_tpu.ops import runtime
 
         fastpath = self._decode_fastpath_set()
+        wire = self._wire_fusion_active()
         size = min(batch_size, self.batch_rows)
         units = self._plan_decode_units(size)
         if not units:
@@ -646,7 +702,7 @@ class ParquetSource(DataSource):
                     )
                     del parts
                     tables = [
-                        _decode_table(merged.slice(start, size), fastpath)
+                        _decode_table(merged.slice(start, size), fastpath, wire)
                         for start in range(0, merged.num_rows, size)
                     ]
                     if sp:

@@ -728,7 +728,7 @@ class Table:
         return Table(cols)
 
     @staticmethod
-    def from_arrow(arrow_table, fastpath_columns=None) -> "Table":
+    def from_arrow(arrow_table, fastpath_columns=None, wire=None) -> "Table":
         """Decode an arrow table into engine Columns.
 
         `fastpath_columns` (a set of names, normally threaded through
@@ -739,23 +739,43 @@ class Table:
         no intermediate numpy materialization. Any column the native
         path cannot take (missing library, unexpected layout) falls back
         to the host chain automatically — the two produce bit-identical
-        Columns, so the fast path is a pure perf decision."""
+        Columns, so the fast path is a pure perf decision.
+
+        `wire` (a `runtime.WireFusionPlan`) goes one step further for
+        its columns: decode straight to the packed device wire format,
+        skipping the Column intermediate entirely. Fused columns get a
+        lazy stub Column plus wire rows collected on the returned
+        table's ``wire_rows`` attribute; any per-batch failure (layout
+        surprise, narrow-int overflow, unresolved shift) falls back to
+        the ordinary decode for that column, that batch."""
         import pyarrow as pa
 
         cols = []
+        wire_rows: Dict[str, object] = {}
         shared: Dict[str, np.ndarray] = {}  # one mask for null-free columns
         fast = None
-        if fastpath_columns:
+        wire_fast = None
+        if fastpath_columns or (wire is not None and wire.columns):
             from deequ_tpu.data import arrow_decode
 
             fast = arrow_decode.decode_fast_column
+            wire_fast = arrow_decode.decode_wire_column
         for name in arrow_table.column_names:
             chunked = arrow_table.column(name)
             if isinstance(chunked, pa.ChunkedArray):
                 chunks = list(chunked.chunks)
             else:
                 chunks = [chunked]
-            if fast is not None and name in fastpath_columns:
+            if wire_fast is not None and wire is not None and name in wire.columns:
+                fused = wire_fast(
+                    name, chunks, arrow_table, wire.columns[name], wire
+                )
+                if fused is not None:
+                    stub, rows = fused
+                    cols.append(stub)
+                    wire_rows.update(rows)
+                    continue
+            if fast is not None and fastpath_columns and name in fastpath_columns:
                 col = fast(name, chunks, arrow_table, shared)
                 if col is not None:
                     cols.append(col)
@@ -774,7 +794,10 @@ class Table:
             cols.append(
                 _column_from_arrow_fallback(name, arr, arrow_table, shared)
             )
-        return Table(cols)
+        table = Table(cols)
+        if wire_rows:
+            table.wire_rows = wire_rows
+        return table
 
     def to_arrow(self, dictionary_encode_strings: bool = False):
         """Arrow table with faithful nulls: the Column neutral-fill

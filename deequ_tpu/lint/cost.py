@@ -113,6 +113,15 @@ class PassCost:
     decode_fallbacks: Tuple[Tuple[str, str], ...] = ()
     saved_decode_bytes: Optional[float] = None
     decode_workers: Optional[int] = None
+    #: decode-to-wire prediction (layered on the fast-path verdict,
+    #: single-engine scans only): columns decoding straight to packed
+    #: wire slices / per-column fall-off reasons with the offending
+    #: consumer key / bytes of host pack re-reads the fused columns skip
+    #: over the decoded rows. None = wire planning will not run (knob
+    #: off, distributed pass, no member plan).
+    wire_fused_cols: Optional[int] = None
+    wire_falloffs: Tuple[Tuple[str, str, str], ...] = ()
+    saved_pack_bytes: Optional[float] = None
     family_groups: Tuple[FamilyGroupCost, ...] = ()
     #: grouping passes: estimated distinct-group count (product of
     #: `approx_distinct` hints); None when any hint is missing
@@ -283,6 +292,14 @@ def cost_drift(cost: "PlanCost", trace: Any) -> Dict[str, float]:
             out["drift.decode_cols_fast"] = float(
                 int(trace.counters.get("decode_cols_fast", 0))
                 - scan.decode_cols_fast
+            )
+        if (
+            scan.wire_fused_cols is not None
+            and "wire_cols_total" in trace.counters
+        ):
+            out["drift.wire_fused_cols"] = float(
+                int(trace.counters.get("wire_fused_cols", 0))
+                - scan.wire_fused_cols
             )
     return out
 
@@ -607,7 +624,10 @@ def analyze_plan(
             from deequ_tpu.ops.fused import (
                 DecodePlan,
                 classify_decode_columns,
+                classify_wire_columns,
                 decode_saved_bytes_per_row,
+                wire_int_bounds_from_groups,
+                wire_saved_pack_bytes_per_row,
             )
 
             if runtime.decode_fastpath_enabled() and native.available():
@@ -659,6 +679,35 @@ def analyze_plan(
                         if decoded_rows is not None
                         else None
                     )
+                    # ---- decode-to-wire verdict (layered on the fast
+                    # set, single-engine scans only — the distributed
+                    # pass plans without a member plan). Mirrors
+                    # plan_decode_fastpath's wire branch: same knob,
+                    # same classifier, same packed-only key set, same
+                    # statically pinned int bounds — so the prediction
+                    # pins to the observed wire_fused_cols counter with
+                    # zero drift.
+                    if not distributed and runtime.wire_fused_enabled():
+                        fast_types = {c: col_types[c] for c in fast}
+                        wire_specs, wire_falloffs = classify_wire_columns(
+                            fast_types,
+                            specs_eff,
+                            plan.packed_only_keys,
+                            compute_dtype.name,
+                            int_bounds=wire_int_bounds_from_groups(
+                                row_groups or (), sorted(fast_types)
+                            ),
+                        )
+                        scan_pass.wire_fused_cols = len(wire_specs)
+                        scan_pass.wire_falloffs = tuple(wire_falloffs)
+                        scan_pass.saved_pack_bytes = (
+                            float(
+                                wire_saved_pack_bytes_per_row(wire_specs)
+                                * decoded_rows
+                            )
+                            if decoded_rows is not None
+                            else None
+                        )
         cost.passes.append(scan_pass)
 
         if streaming:

@@ -49,6 +49,7 @@ CODES = {
     "DQ310": "where predicate not pushdown-eligible",
     "DQ311": "statistics prove every row group skippable",
     "DQ312": "column falls off the decode fast path",
+    "DQ313": "column falls off decode-to-wire fusion",
 }
 
 

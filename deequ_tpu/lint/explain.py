@@ -268,6 +268,25 @@ def cost_diagnostics(
                     source=col,
                 )
             )
+
+    # DQ313 — decode-to-wire fusion: fast-path columns that still build
+    # the Column intermediate because a consumer needs it. The planner's
+    # reason names the offending consumer key when there is one, and the
+    # caret lands on it — so the fix (drop the host re-read, move the
+    # member onto the compiled reduce) is actionable per column.
+    if scan is not None and scan.wire_falloffs:
+        for col, reason, key in scan.wire_falloffs:
+            diags.append(
+                Diagnostic(
+                    "DQ313",
+                    Severity.WARNING,
+                    f"column {col!r} decodes to a host Column instead of "
+                    f"fusing straight to the wire ({reason}): its pack "
+                    "re-reads the decoded arrays every batch",
+                    source=key or col,
+                    span=(0, len(key)) if key else None,
+                )
+            )
     return diags
 
 
@@ -320,6 +339,14 @@ def _render_pass(p: PassCost, idx: int) -> List[str]:
                     f" (avoids ~{_fmt_bytes(p.saved_decode_bytes)} "
                     "intermediate)"
                 )
+            lines.append(line)
+        if p.wire_fused_cols is not None and p.decode_cols_total is not None:
+            line = (
+                f"  wire: {p.wire_fused_cols}/{p.decode_cols_total} "
+                "column(s) fused at decode"
+            )
+            if p.saved_pack_bytes:
+                line += f" (skips ~{_fmt_bytes(p.saved_pack_bytes)} pack)"
             lines.append(line)
         for g in p.family_groups:
             tag = "batched" if g.batched else "solo"

@@ -98,3 +98,15 @@ def record_decode_fastpath(fast: int, total: int, workers: int) -> None:
         tracer.count("decode_cols_total", int(total))
         tracer.count("decode_workers", int(workers))
         tracer.count("decode_passes", 1)
+
+
+def record_wire_fused(fused: int, total: int) -> None:
+    """Decode-to-wire outcome of one fused scan: columns whose wire
+    buffers the decode workers emit directly vs columns scanned.
+    Tracer-only, like record_decode_fastpath; the counters feed
+    cost_drift's wire pin and the `engine.wire_fused_ratio` telemetry
+    series."""
+    tracer = spans.current_tracer()
+    if tracer is not None:
+        tracer.count("wire_fused_cols", int(fused))
+        tracer.count("wire_cols_total", int(total))

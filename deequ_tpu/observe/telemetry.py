@@ -170,6 +170,15 @@ def engine_metric_record(
             rec.get("engine.counter.decode_workers", 0.0) / decode_passes
         )
 
+    # derived: fraction of scanned columns decoded STRAIGHT to the wire
+    # (decode-to-wire fusion) — the sentinel watches it for fall-off
+    # regressions; only present when a wire verdict actually ran
+    wire_total = rec.get("engine.counter.wire_cols_total", 0.0)
+    if wire_total > 0.0:
+        rec["engine.wire_fused_ratio"] = (
+            rec.get("engine.counter.wire_fused_cols", 0.0) / wire_total
+        )
+
     # satellite: traced_run stamps these on the root span; live /proc read
     # covers traces produced before the attributes existed.
     res = proc_resources()

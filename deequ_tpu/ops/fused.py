@@ -43,11 +43,11 @@ _FUSED_CACHE_LOCK = threading.Lock()
 def _pad_size(n: int, batch_size: int) -> int:
     """Round up to a power of two (min 8): few compiled shapes, no
     per-tail recompilation. Always a multiple of 8 so bitpacked masks
-    (1 bit/row) decode to exactly `padded` rows."""
-    size = 8
-    while size < n:
-        size *= 2
-    return min(size, max(-(-batch_size // 8) * 8, 8))
+    (1 bit/row) decode to exactly `padded` rows. Delegates to
+    runtime.wire_pad_size — the decode-to-wire workers size their
+    pre-packed rows with the same function, so the two can never
+    disagree on a batch's padded length."""
+    return runtime.wire_pad_size(n, batch_size)
 
 
 def _pack_outputs(tree):
@@ -129,6 +129,13 @@ def get_fused_fn(
                             shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
                             bits = (row[:, None] >> shifts[None, :]) & jnp.uint8(1)
                             inputs[in_key] = bits.reshape(-1).astype(jnp.bool_)
+                        elif kind == "ival":
+                            # decode-to-wire narrowed int row for a num:
+                            # key: widen to the compute dtype (the planner
+                            # pinned a width whose every value is exact in
+                            # float64, so this equals the f64 row the
+                            # Column path would have shipped)
+                            inputs[in_key] = row.astype(runtime.compute_dtype())
                         elif kind == "int" and row.dtype.itemsize < 4:
                             # widen wire-narrowed ints; int32/int64 as-is
                             inputs[in_key] = row.astype(jnp.int32)
@@ -197,7 +204,9 @@ def wire_shifts(sticky) -> Dict[str, float]:
     }
 
 
-def pack_batch_inputs(built_items, padded: int, dtype, sticky=None, num_rows=None):
+def pack_batch_inputs(
+    built_items, padded: int, dtype, sticky=None, num_rows=None, prepacked=None
+):
     """Build the minimal wire format for one batch.
 
     The tunnel to the device moves ~10MB/s (measured; a real TPU host moves
@@ -210,6 +219,15 @@ def pack_batch_inputs(built_items, padded: int, dtype, sticky=None, num_rows=Non
     Same-format arrays are concatenated into ONE flat 1-D buffer per group
     so each put streams at bandwidth instead of paying per-array latency.
 
+    `prepacked` maps input keys to runtime.WireRows the decode-to-wire
+    workers already emitted in final wire form (the batch Table's
+    ``wire_rows``): their padded buffers splice into the group buffers
+    verbatim — no packbits, no narrowing, no shift math here. A
+    prepacked key's built array may be None (the Column was never
+    materialized). Sticky pinning follows the same rules as the packed
+    route, so fused and fallback batches of one pass converge on the
+    same layout.
+
     Returns (packed_inputs, layout); `layout` is hashable and keys the
     compiled program (groups, const_keys, padded). `sticky` (a dict the
     caller keeps for the life of one pass) pins each key's wire format
@@ -219,6 +237,8 @@ def pack_batch_inputs(built_items, padded: int, dtype, sticky=None, num_rows=Non
     """
     if sticky is None:
         sticky = {}
+    if prepacked is None:
+        prepacked = {}
     _built_map = {k: a for k, a in built_items}
 
     def _built_lookup(key: str):
@@ -227,6 +247,30 @@ def pack_batch_inputs(built_items, padded: int, dtype, sticky=None, num_rows=Non
     entries_by_group: Dict[tuple, List[tuple]] = {}
     const_keys: List[str] = []
     for key, arr in built_items:
+        wire_row = prepacked.get(key)
+        if wire_row is not None:
+            if wire_row.kind == "bits":
+                # same elision/pinning ladder as the bool branch below:
+                # all-valid rows elide to const until any batch has an
+                # invalid row, then the key is bits for the pass
+                if wire_row.all_valid and sticky.get(key, "const") == "const":
+                    sticky[key] = "const"
+                    const_keys.append(key)
+                    continue
+                sticky[key] = "bits"
+                entries_by_group.setdefault(("uint8", "bits"), []).append(
+                    (key, "bits", wire_row.arr)
+                )
+            elif wire_row.kind == "ival":
+                entries_by_group.setdefault(
+                    (wire_row.arr.dtype.name, "ival"), []
+                ).append((key, "ival", wire_row.arr))
+            else:  # "val": compute-dtype row, shift already applied
+                sticky.setdefault(f"shift:{key}", wire_row.shift)
+                entries_by_group.setdefault(
+                    (np.dtype(dtype).name, "val"), []
+                ).append((key, "val", wire_row.arr))
+            continue
         if num_rows is None:
             num_rows = len(arr)
         if arr.dtype == np.bool_:
@@ -306,8 +350,24 @@ class ScanMemberPlan:
     host_assisted_idx: List[int] = field(default_factory=list)
     specs: Dict[str, Any] = field(default_factory=dict)
     device_keys: set = field(default_factory=set)
+    # device keys consumed by device-ASSISTED members: their host
+    # finishers may re-read the built host arrays (fold.submit's
+    # host_ctx), so these keys are not packed-only and the decode-to-wire
+    # planner must keep their columns on the Column path
+    assisted_keys: set = field(default_factory=set)
     host_keys: Dict[int, List[str]] = field(default_factory=dict)
     spec_errors: Dict[int, BaseException] = field(default_factory=dict)
+
+    @property
+    def packed_only_keys(self) -> set:
+        """Device keys whose ONLY consumers are merge members' compiled
+        reduces — the keys that live purely on the packed wire. The
+        decode-to-wire planner may fuse a column exactly when every one
+        of its consumer keys is in this set."""
+        host = set()
+        for keys in self.host_keys.values():
+            host.update(keys)
+        return self.device_keys - self.assisted_keys - host
 
     @property
     def device_member_count(self) -> int:
@@ -353,6 +413,7 @@ def plan_scan_members(analyzers: Sequence[Any], mode: Optional[str] = None) -> S
             else:
                 plan.assisted_idx.append(i)
                 plan.device_keys.update(s.key for s in analyzer_specs)
+                plan.assisted_keys.update(s.key for s in analyzer_specs)
         elif host_all or (
             host_discrete and getattr(analyzer, "discrete_inputs", False)
         ):
@@ -560,11 +621,21 @@ class DecodePlan:
     which columns take the buffer-level native fast path, which fall
     back to the host chain (with the reason, for EXPLAIN's DQ312), and
     the worker count the scan decodes with. Purely a perf/accounting
-    decision — both routes emit bit-identical Columns."""
+    decision — both routes emit bit-identical Columns.
+
+    The wire_* fields carry the decode-to-wire verdict layered on top:
+    columns (a subset of `fast`) whose every live consumer is
+    packed-only decode STRAIGHT to wire buffers, and the rest of the
+    wire candidates record why they stayed on the Column path (column,
+    reason, offending consumer key — EXPLAIN's DQ313 caret)."""
 
     fast: Tuple[str, ...]
     fallbacks: Tuple[Tuple[str, str], ...]  # (column, reason)
     workers: int
+    wire_fused: Tuple[str, ...] = ()
+    wire_falloffs: Tuple[Tuple[str, str, str], ...] = ()  # (col, reason, key)
+    wire_batch: int = 0
+    wire_specs: Any = field(default=None, compare=False)  # col -> ColumnWireSpec
 
     @property
     def total(self) -> int:
@@ -623,12 +694,242 @@ def decode_saved_bytes_per_row(plan: DecodePlan, col_types: Dict[str, str]) -> i
     )
 
 
-def plan_decode_fastpath(table, specs: Dict[str, Any]):
+#: integer arrow tokens the wire kernels take (uint64 deliberately
+#: absent: the OFF path ships it through int64-wrap semantics the wire
+#: kernels don't reproduce) and their type value bounds
+_WIRE_INT_TOKEN_BOUNDS = {
+    "int8": (-(1 << 7), (1 << 7) - 1),
+    "int16": (-(1 << 15), (1 << 15) - 1),
+    "int32": (-(1 << 31), (1 << 31) - 1),
+    "int64": (-(1 << 63), (1 << 63) - 1),
+    "uint8": (0, (1 << 8) - 1),
+    "uint16": (0, (1 << 16) - 1),
+    "uint32": (0, (1 << 32) - 1),
+}
+
+#: narrow wire dtypes an int column may pin to, narrowest first
+_WIRE_NARROW_LADDER = (
+    ("int8", -(1 << 7), (1 << 7) - 1),
+    ("int16", -(1 << 15), (1 << 15) - 1),
+    ("int32", -(1 << 31), (1 << 31) - 1),
+)
+
+
+def _pin_int_wire_width(token: str, bounds) -> Optional[str]:
+    """The narrowest exact wire dtype for an int column, pinned
+    STATICALLY for the whole pass: from the file's min/max statistics
+    when every row group has them, else from the arrow type's value
+    bounds. The range always widens to include 0 (the null fill the
+    kernels write). None when nothing ≤ int32 holds the range — the
+    column then ships as a float64 value row, which is what the Column
+    path produces for every integer anyway."""
+    lo, hi = _WIRE_INT_TOKEN_BOUNDS[token]
+    if bounds is not None:
+        lo, hi = bounds
+    lo = min(int(lo), 0)
+    hi = max(int(hi), 0)
+    for name, dlo, dhi in _WIRE_NARROW_LADDER:
+        if dlo <= lo and hi <= dhi:
+            return name
+    return None
+
+
+def classify_wire_columns(
+    col_types: Dict[str, str],
+    specs: Dict[str, Any],
+    packed_only_keys: set,
+    dtype_name: str,
+    int_bounds: Optional[Dict[str, Any]] = None,
+):
+    """Pure decode-to-wire eligibility split over a scan's columns.
+
+    A column fuses iff its every live consumer key is `num:{col}` /
+    `valid:{col}` AND in `packed_only_keys` (merge members' compiled
+    reduces only — see ScanMemberPlan.packed_only_keys), its token has a
+    wire kernel, and its wire value layout is statically known. Anything
+    else stays on the Column path with a (column, reason, offending key)
+    record for EXPLAIN's DQ313. `dtype_name` is the compute dtype
+    ('float64'/'float32'); `int_bounds` maps columns to (min, max) file
+    statistics (None/absent = no usable stats). Shared verbatim by the
+    planner and the cost model so prediction and execution can never
+    disagree."""
+    from deequ_tpu.ops import native
+
+    wire_specs: Dict[str, runtime.ColumnWireSpec] = {}
+    falloffs: List[Tuple[str, str, str]] = []
+    int_bounds = int_bounds or {}
+    candidates = [
+        name
+        for name in sorted(col_types)
+        if col_types[name] in ("double", "float", "bool")
+        or col_types[name] in _WIRE_INT_TOKEN_BOUNDS
+        or col_types[name] == "uint64"
+    ]
+    if not candidates:
+        return wire_specs, falloffs
+    unknown_reads = any(spec.columns is None for spec in specs.values())
+    consumers: Dict[str, set] = {}
+    for spec in specs.values():
+        for col in spec.columns or ():
+            consumers.setdefault(col, set()).add(spec.key)
+    for name in candidates:
+        token = col_types[name]
+        if unknown_reads:
+            falloffs.append(
+                (name, "an input spec reads unknown columns", "")
+            )
+            continue
+        if token == "uint64":
+            falloffs.append(
+                (name, "uint64 int64-wrap semantics stay on the Column path", "")
+            )
+            continue
+        keys = consumers.get(name, set())
+        if not keys:
+            falloffs.append((name, "no live consumer reads this column", ""))
+            continue
+        allowed = {f"num:{name}", f"valid:{name}"}
+        bad = sorted(keys - allowed)
+        if bad:
+            falloffs.append(
+                (name, f"consumer {bad[0]} needs the host Column", bad[0])
+            )
+            continue
+        off_wire = sorted(keys - packed_only_keys)
+        if off_wire:
+            falloffs.append(
+                (
+                    name,
+                    f"{off_wire[0]} is re-read off-wire by a host/assisted member",
+                    off_wire[0],
+                )
+            )
+            continue
+        want_value = f"num:{name}" in keys
+        want_valid = f"valid:{name}" in keys
+        value_kind = ""
+        value_dtype = ""
+        needs_shift = False
+        desc = "bits"
+        if want_value:
+            if token == "bool":
+                falloffs.append(
+                    (
+                        name,
+                        "bool numeric values build host-side (astype)",
+                        f"num:{name}",
+                    )
+                )
+                continue
+            if token in ("double", "float"):
+                value_kind = "val"
+                value_dtype = dtype_name
+                needs_shift = dtype_name == "float32"
+                desc = "f32+shift" if needs_shift else "f64"
+            elif dtype_name == "float32":
+                # f32 wire ships ints as shifted f32 value rows, exactly
+                # like the Column path's pack
+                value_kind = "val"
+                value_dtype = "float32"
+                needs_shift = True
+                desc = "f32+shift"
+            else:
+                narrow = _pin_int_wire_width(token, int_bounds.get(name))
+                if narrow is None:
+                    value_kind = "val"
+                    value_dtype = "float64"
+                    desc = "f64"
+                else:
+                    value_kind = "ival"
+                    value_dtype = narrow
+                    desc = narrow.replace("int", "i")
+            if not native.wire_supported(token, value_dtype):
+                falloffs.append(
+                    (name, f"no wire kernel for {token}->{value_dtype}", "")
+                )
+                continue
+        wire_specs[name] = runtime.ColumnWireSpec(
+            column=name,
+            token=token,
+            want_value=want_value,
+            want_valid=want_valid,
+            value_kind=value_kind,
+            value_dtype=value_dtype,
+            needs_shift=needs_shift,
+            desc=desc,
+        )
+    return wire_specs, falloffs
+
+
+def wire_saved_pack_bytes_per_row(wire_specs: Dict[str, Any]) -> int:
+    """Predicted bytes/row of host pack work the fused columns skip: the
+    full-width value array pack re-reads plus the uint8 mask packbits
+    re-reads, per column. Prediction-only accounting for EXPLAIN/cost."""
+    saved = 0
+    for spec in wire_specs.values():
+        if spec.want_value:
+            saved += 8  # the f64 numeric_values array pack re-reads
+        if spec.want_valid:
+            saved += 1  # the uint8 mask packbits re-reads
+    return saved
+
+
+def wire_int_bounds(table, columns) -> Dict[str, Any]:
+    """Per-column (min, max) from the file's row-group statistics, for
+    the wire planner's static narrow-int pinning. A column appears only
+    when EVERY row group has usable min/max — a single missing stat
+    falls the column back to its type bounds (wider, never wrong).
+    Empty on any error: bounds are an optimization input."""
+    stats_fn = getattr(table, "row_group_stats", None)
+    if stats_fn is None or not columns:
+        return {}
+    try:
+        groups = stats_fn()
+    except Exception:  # noqa: BLE001
+        return {}
+    return wire_int_bounds_from_groups(groups, columns)
+
+
+def wire_int_bounds_from_groups(groups, columns) -> Dict[str, Any]:
+    """Same pinning input computed from already-loaded row-group stats —
+    the cost model replays the wire verdict from its `row_groups`
+    argument without a live source handle."""
+    if not groups:
+        return {}
+    bounds: Dict[str, Any] = {}
+    for name in columns:
+        lo = hi = None
+        for rg in groups:
+            st = rg.columns.get(name)
+            if st is None or st.min_value is None or st.max_value is None:
+                lo = None
+                break
+            try:
+                g_lo, g_hi = int(st.min_value), int(st.max_value)
+            except (TypeError, ValueError):
+                lo = None
+                break
+            lo = g_lo if lo is None else min(lo, g_lo)
+            hi = g_hi if hi is None else max(hi, g_hi)
+        if lo is not None and hi is not None:
+            bounds[name] = (lo, hi)
+    return bounds
+
+
+def plan_decode_fastpath(
+    table, specs: Dict[str, Any], member_plan=None, batch_size: int = 0
+):
     """Build a DecodePlan for a parquet-backed scan, or None when the
     knob is off, the source has no decode-planning surface, the native
     library is unavailable, or anything at all goes wrong — the fast
     path is an optimization, never a failure mode. Call AFTER column
-    pruning so only surviving columns are classified."""
+    pruning so only surviving columns are classified.
+
+    With `member_plan` (the pass's ScanMemberPlan) and `batch_size`, the
+    plan layers the decode-to-wire verdict on top: fast columns whose
+    every consumer is packed-only get a ColumnWireSpec and skip the
+    Column intermediate entirely (DEEQU_TPU_WIRE_FUSED gates this
+    independently of the fast path)."""
     if not runtime.decode_fastpath_enabled():
         return None
     types_fn = getattr(table, "decode_column_types", None)
@@ -643,10 +944,31 @@ def plan_decode_fastpath(table, specs: Dict[str, Any]):
         if not col_types:
             return None
         fast, fallbacks = classify_decode_columns(col_types, specs)
+        wire_specs: Dict[str, Any] = {}
+        wire_falloffs: List[Tuple[str, str, str]] = []
+        if (
+            member_plan is not None
+            and batch_size > 0
+            and runtime.wire_fused_enabled()
+            and getattr(table, "with_wire_fusion", None) is not None
+        ):
+            fast_types = {c: col_types[c] for c in fast}
+            dtype_name = np.dtype(runtime.compute_dtype()).name
+            wire_specs, wire_falloffs = classify_wire_columns(
+                fast_types,
+                specs,
+                member_plan.packed_only_keys,
+                dtype_name,
+                int_bounds=wire_int_bounds(table, sorted(fast_types)),
+            )
         return DecodePlan(
             fast=tuple(fast),
             fallbacks=tuple(fallbacks),
             workers=runtime.decode_workers(),
+            wire_fused=tuple(sorted(wire_specs)),
+            wire_falloffs=tuple(wire_falloffs),
+            wire_batch=int(batch_size),
+            wire_specs=wire_specs or None,
         )
     except Exception:  # noqa: BLE001
         return None
@@ -654,21 +976,34 @@ def plan_decode_fastpath(table, specs: Dict[str, Any]):
 
 def apply_decode_plan(table, plan: DecodePlan):
     """Act on a DecodePlan: record the `decode_fastpath` span + counters
-    (the trace side of cost_drift's zero-drift pin and the
-    engine.decode_fastpath_ratio telemetry series), then view the source
-    with the fast set attached."""
+    (the trace side of cost_drift's zero-drift pins and the
+    engine.decode_fastpath_ratio / engine.wire_fused_ratio telemetry
+    series), then view the source with the fast set — and, when the
+    wire verdict fused columns, the WireFusionPlan — attached."""
     with observe.span(
         "decode_fastpath",
         cat="plan",
         cols_total=plan.total,
         cols_fast=len(plan.fast),
         cols_fallback=len(plan.fallbacks),
+        cols_wire_fused=len(plan.wire_fused),
         workers=plan.workers,
     ):
         pass
     runtime.record_decode_fastpath(len(plan.fast), plan.total, plan.workers)
+    if plan.wire_batch > 0:
+        # wire planning ran (single-engine pass with a member plan):
+        # record the verdict even when it fused nothing, so the drift
+        # pin sees 0 predicted == 0 observed rather than a missing series
+        runtime.record_wire_fused(len(plan.wire_fused), plan.total)
     if plan.fast:
         table = table.with_decode_fastpath(plan.fast)
+    if plan.wire_specs:
+        with_wire = getattr(table, "with_wire_fusion", None)
+        if with_wire is not None:
+            table = with_wire(
+                runtime.WireFusionPlan(plan.wire_specs, plan.wire_batch)
+            )
     return table
 
 
@@ -1318,7 +1653,9 @@ class FusedScanPass:
             # decode routing comes last: it classifies exactly the
             # columns that survived pruning (with_columns returns a new
             # source, so the fast set must attach to the final view)
-            decode_plan = plan_decode_fastpath(table, specs)
+            decode_plan = plan_decode_fastpath(
+                table, specs, member_plan=plan, batch_size=self.batch_size
+            )
             if decode_plan is not None:
                 table = apply_decode_plan(table, decode_plan)
             merge_analyzers = [self.analyzers[i] for i in merge_idx]
@@ -1406,6 +1743,11 @@ class FusedScanPass:
         fold = PipelinedAggFold(analyzers, assisted, sticky=sticky)
         device_spec_keys = sorted(device_keys)
         streaming = bool(getattr(table, "is_streaming", False))
+        # decode-to-wire handshake: the source's attached WireFusionPlan
+        # (None when not planned). After every pack the resolved sticky
+        # shifts publish through it so decode workers can start fusing
+        # shift-needing columns; a device death abandons the handshake.
+        wire_plan = getattr(table, "wire_plan", None)
 
         # host fold state: per host member, (f64 aggregate, error)
         host_aggs: Dict[int, Any] = {}
@@ -1490,12 +1832,16 @@ class FusedScanPass:
                     if not device_live and not host_live:
                         break  # everything already failed; stop scanning
                     # device keys build eagerly (the shared program needs them
-                    # packed); host-only keys build lazily on member access
+                    # packed); host-only keys build lazily on member access.
+                    # Keys the decode workers already emitted in wire form
+                    # (batch.wire_rows) skip the build entirely.
                     built = HostInputs(specs, batch)
                     build_errors = built.build_errors
+                    wire_rows = getattr(batch, "wire_rows", None) or {}
                     if device_live:
                         for key in device_spec_keys:
-                            built.materialize(key)
+                            if key not in wire_rows:
+                                built.materialize(key)
                     if use_device and device_error is None:
                         try:
                             with observe.span(
@@ -1506,9 +1852,22 @@ class FusedScanPass:
                                         raise build_errors[key]
                                 padded = _pad_size(batch.num_rows, self.batch_size)
                                 packed_inputs, layout = pack_batch_inputs(
-                                    [(k, built[k]) for k in device_spec_keys],
+                                    [
+                                        (k, None if k in wire_rows else built[k])
+                                        for k in device_spec_keys
+                                    ],
                                     padded, dtype, sticky, num_rows=batch.num_rows,
+                                    prepacked=wire_rows,
                                 )
+                                if wire_plan is not None:
+                                    wire_plan.publish_shifts(
+                                        {
+                                            k: float(
+                                                sticky.get(f"shift:{k}", 0.0)
+                                            )
+                                            for k in wire_plan.shift_keys
+                                        }
+                                    )
                                 if dispatch_sp:
                                     dispatch_sp.set(
                                         wire_bytes=int(
@@ -1530,6 +1889,8 @@ class FusedScanPass:
                                 )
                         except Exception as e:  # noqa: BLE001
                             device_error = e
+                            if wire_plan is not None:
+                                wire_plan.abandon_shifts()
                     with observe.span("host_fold", cat="host", rows=batch.num_rows):
                         fold_host_batch(
                             built, build_errors, host_members, host_assisted,
@@ -1600,16 +1961,27 @@ class FusedScanPass:
         # failure on the prep thread or a dispatch/runtime failure here,
         # so in-flight batches stop paying for device packing
         device_down = threading.Event()
+        wire_plan = getattr(table, "wire_plan", None)
 
         def _prep(batch):
             built = HostInputs(specs, batch)
             packed_inputs = layout = device_exc = None
+            wire_rows = getattr(batch, "wire_rows", None) or {}
             if use_device and not device_down.is_set():
+                if wire_plan is not None:
+                    # opens the decode workers' shift_for wait window:
+                    # from here a publish is imminent, so overlapped
+                    # batches briefly wait instead of falling back
+                    wire_plan.mark_pack_started()
                 for key in device_spec_keys:
-                    built.materialize(key)
+                    if key not in wire_rows:
+                        built.materialize(key)
                 try:
                     with observe.span(
-                        "dispatch", cat="dispatch", rows=batch.num_rows
+                        "dispatch",
+                        cat="dispatch",
+                        rows=batch.num_rows,
+                        wire_fuse=len(wire_rows),
                     ) as dispatch_sp:
                         for key in device_spec_keys:
                             if key in built.build_errors:
@@ -1617,11 +1989,27 @@ class FusedScanPass:
                         padded = _pad_size(batch.num_rows, self.batch_size)
                         # the H2D put happens HERE (jnp.asarray inside):
                         # batch N+1's wire lands device-side while the
-                        # fold stage still runs batch N
+                        # fold stage still runs batch N. Keys in
+                        # batch.wire_rows splice in the decode workers'
+                        # pre-packed buffers instead of packing here.
                         packed_inputs, layout = pack_batch_inputs(
-                            [(k, built[k]) for k in device_spec_keys],
+                            [
+                                (k, None if k in wire_rows else built[k])
+                                for k in device_spec_keys
+                            ],
                             padded, dtype, sticky, num_rows=batch.num_rows,
+                            prepacked=wire_rows,
                         )
+                        if wire_plan is not None:
+                            # single prep thread: sticky shifts are final
+                            # after this batch's pack — open the decode
+                            # workers' shift gate
+                            wire_plan.publish_shifts(
+                                {
+                                    k: float(sticky.get(f"shift:{k}", 0.0))
+                                    for k in wire_plan.shift_keys
+                                }
+                            )
                         if dispatch_sp:
                             dispatch_sp.set(
                                 wire_bytes=int(
@@ -1635,6 +2023,8 @@ class FusedScanPass:
                     device_exc = e
                     packed_inputs = layout = None
                     device_down.set()
+                    if wire_plan is not None:
+                        wire_plan.abandon_shifts()
             if any(i not in host_errors for i, _m in all_host):
                 with observe.span(
                     "host_prep", cat="host", rows=batch.num_rows
@@ -1686,6 +2076,8 @@ class FusedScanPass:
                                     device_error = e
                             if device_error is not None:
                                 device_down.set()
+                                if wire_plan is not None:
+                                    wire_plan.abandon_shifts()
                         with observe.span(
                             "host_fold", cat="host", rows=batch.num_rows
                         ):

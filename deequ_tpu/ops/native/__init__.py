@@ -308,6 +308,58 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint8),
         ]
         lib.decode_dict_i32.restype = ctypes.c_int64
+        # decode-to-wire kernels: same raw-address convention as the
+        # Column decode above, but the outputs are the WIRE buffers
+        # (bitpacked MSB mask row + value row), written at a row/bit
+        # offset inside the batch's preallocated padded buffers.
+        lib.wire_valid_bits.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+        ]
+        lib.wire_valid_bits.restype = ctypes.c_int64
+        for name in (
+            "wire_f64",
+            "wire_f64_to_f32",
+            "wire_f32_to_f64",
+            "wire_f32",
+        ):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_double,
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int64,
+            ]
+            fn.restype = ctypes.c_int64
+        for name in (
+            "wire_i8",
+            "wire_i16",
+            "wire_i32",
+            "wire_i64",
+            "wire_u8",
+            "wire_u16",
+            "wire_u32",
+        ):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int,
+                ctypes.c_double,
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int64,
+            ]
+            fn.restype = ctypes.c_int64
         _LIB = lib
     except OSError:
         _LIB = None
@@ -820,6 +872,141 @@ def decode_dict_codes(
             out_valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         )
     )
+
+
+#: arrow float type -> wire-dtype-keyed decode-to-wire entry points
+_WIRE_FLOAT_KERNELS = {
+    ("double", "float64"): "wire_f64",
+    ("double", "float32"): "wire_f64_to_f32",
+    ("float", "float64"): "wire_f32_to_f64",
+    ("float", "float32"): "wire_f32",
+}
+
+#: arrow int type -> decode-to-wire entry point (uint64 is deliberately
+#: absent: its int64-path wrap semantics stay on the Column path)
+_WIRE_INT_KERNELS = {
+    "int8": "wire_i8",
+    "int16": "wire_i16",
+    "int32": "wire_i32",
+    "int64": "wire_i64",
+    "uint8": "wire_u8",
+    "uint16": "wire_u16",
+    "uint32": "wire_u32",
+}
+
+#: wire value dtype -> the int kernels' out_code selector
+_WIRE_OUT_CODES = {
+    "int8": 0,
+    "int16": 1,
+    "int32": 2,
+    "float64": 3,
+    "float32": 4,
+}
+
+
+def wire_supported(token: str, out_dtype_name: str) -> bool:
+    """True when a decode-to-wire kernel exists for (arrow type token,
+    wire value dtype). The planner keys eligibility off this so it can
+    never approve a column the decoder cannot take."""
+    if (token, out_dtype_name) in _WIRE_FLOAT_KERNELS:
+        return True
+    return token in _WIRE_INT_KERNELS and out_dtype_name in _WIRE_OUT_CODES
+
+
+@_traced_kernel
+def wire_valid_bits(
+    validity_addr: Optional[int],
+    bit_offset: int,
+    n: int,
+    out_bits: np.ndarray,
+    out_bit_offset: int,
+) -> Optional[int]:
+    """Validity bitmap (LSB order) -> wire mask bits (np.packbits MSB
+    order) OR-ed into the prezeroed padded row at `out_bit_offset`.
+    Returns the invalid-row count; None when native is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    return int(
+        lib.wire_valid_bits(
+            ctypes.c_void_p(validity_addr) if validity_addr else None,
+            int(bit_offset),
+            int(n),
+            out_bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            int(out_bit_offset),
+        )
+    )
+
+
+@_traced_kernel
+def wire_primitive(
+    token: str,
+    values_addr: int,
+    validity_addr: Optional[int],
+    bit_offset: int,
+    n: int,
+    shift: float,
+    out_values: Optional[np.ndarray],
+    out_bits: Optional[np.ndarray],
+    out_bit_offset: int,
+) -> Optional[int]:
+    """One-pass Arrow-buffer decode of a numeric chunk STRAIGHT to the
+    wire: value row in `out_values`' dtype (floats pre-centered by the
+    sticky `shift`; ints range-checked against the pinned narrow width)
+    plus MSB mask bits (validity AND NaN fold) OR-ed into `out_bits` at
+    `out_bit_offset`. Either output may be None to skip it. Returns the
+    invalid-row count, or None when the native library is unavailable,
+    the (token, wire dtype) pair has no kernel, or a value overflowed
+    the pinned narrow range (caller falls back to the Column path)."""
+    lib = _load()
+    if lib is None:
+        return None
+    out_dtype_name = out_values.dtype.name if out_values is not None else None
+    bits_ptr = (
+        out_bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if out_bits is not None
+        else None
+    )
+    vals_ptr = (
+        out_values.ctypes.data_as(ctypes.c_void_p)
+        if out_values is not None
+        else None
+    )
+    validity_ptr = ctypes.c_void_p(validity_addr) if validity_addr else None
+    if token in ("double", "float"):
+        name = _WIRE_FLOAT_KERNELS.get((token, out_dtype_name or "float64"))
+        if name is None:
+            return None
+        rc = getattr(lib, name)(
+            ctypes.c_void_p(values_addr),
+            validity_ptr,
+            int(bit_offset),
+            int(n),
+            float(shift),
+            vals_ptr,
+            bits_ptr,
+            int(out_bit_offset),
+        )
+    else:
+        name = _WIRE_INT_KERNELS.get(token)
+        code = _WIRE_OUT_CODES.get(out_dtype_name or "")
+        if name is None or code is None:
+            return None
+        rc = getattr(lib, name)(
+            ctypes.c_void_p(values_addr),
+            validity_ptr,
+            int(bit_offset),
+            int(n),
+            int(code),
+            float(shift),
+            vals_ptr,
+            bits_ptr,
+            int(out_bit_offset),
+        )
+    rc = int(rc)
+    if rc < 0:
+        return None
+    return rc
 
 
 @_traced_kernel

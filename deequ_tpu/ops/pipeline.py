@@ -37,6 +37,16 @@ threads adopt the dispatching thread's trace context
 (`observe.attached`) and report a `pipe_stage` span with one
 `pipe_item` child per batch — what the run report's pipeline-occupancy
 section aggregates; with tracing off, spans hit the no-op fast path.
+
+Occupancy attribution under decode-to-wire fusion
+(`DEEQU_TPU_WIRE_FUSED`): a fused column's bit-packing and value
+narrowing/shifting run inside the decode workers' native kernels, so
+that work leaves the prep stage's `pack_batch_inputs` bucket and lands
+in the DECODE stage's busy time (where the arrow_decode spans live).
+The occupancy report therefore re-baselines when fusion toggles —
+decode busy_s rises by roughly the pack time that prep loses, and the
+total stays accounted: time moves between stage buckets, it is never
+dropped (BENCH.md's round-10 table shows the A/B).
 """
 
 from __future__ import annotations

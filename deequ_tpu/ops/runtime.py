@@ -229,6 +229,130 @@ def decode_fastpath_enabled() -> bool:
     return os.environ.get("DEEQU_TPU_DECODE_FASTPATH", "") not in ("0", "off")
 
 
+def wire_fused_enabled() -> bool:
+    """Whether planner-approved packed-only columns may decode STRAIGHT
+    to the device wire format (ops/native/decode.c wire kernels):
+    bitpacked mask rows, narrowed int rows, shifted float rows emitted
+    by the decode workers, skipping both the Column intermediate and
+    pack_batch_inputs' serial numpy pack for those columns.
+
+    `DEEQU_TPU_WIRE_FUSED=0` (or `off`) is the kill switch: every column
+    materializes a Column and packs in prep, exactly as before — the
+    baseline the wire differential suite compares against. The device
+    sees identical input values either way, so metrics are
+    bit-identical; only where the wire bytes are produced changes."""
+    import os
+
+    return os.environ.get("DEEQU_TPU_WIRE_FUSED", "") not in ("0", "off")
+
+
+def wire_pad_size(n: int, batch_size: int) -> int:
+    """The fused pass's padded row length for an n-row batch (mirror of
+    ops/fused.py:_pad_size, which delegates here): power of two, min 8,
+    capped at batch_size rounded up to a multiple of 8. Lives in runtime
+    so data/source.py's decode-to-wire path can size wire rows without
+    importing the fused engine."""
+    size = 8
+    while size < n:
+        size *= 2
+    return min(size, max(-(-batch_size // 8) * 8, 8))
+
+
+@dataclass(frozen=True)
+class ColumnWireSpec:
+    """Statically pinned wire layout for one decode-to-wire column:
+    which wire rows its packed consumers need and the exact dtypes, so
+    every batch of the pass ships the same layout (the sticky contract)
+    and decode can emit final wire bytes without seeing any data."""
+
+    column: str
+    token: str  # arrow type token the chunk must match at decode time
+    want_value: bool  # a num:{column} spec is live
+    want_valid: bool  # a valid:{column} spec is live
+    value_kind: str = ""  # "val" (compute dtype) | "ival" (narrow int)
+    value_dtype: str = ""  # numpy dtype name of the wire value row
+    needs_shift: bool = False  # f32 wire: wait for the sticky shift
+    desc: str = ""  # short render token for EXPLAIN ("f64", "i8", ...)
+
+
+@dataclass
+class WireRow:
+    """One pre-packed wire row decode attaches to a batch Table
+    (`table.wire_rows[key]`): the padded buffer pack_batch_inputs splices
+    into the batch's group buffer verbatim."""
+
+    kind: str  # "bits" | "val" | "ival"
+    arr: "np.ndarray"
+    shift: float = 0.0
+    all_valid: bool = False  # bits row with zero invalid rows (may elide)
+
+
+class WireFusionPlan:
+    """The decode↔pack handshake for one fused pass.
+
+    Carries the per-column ColumnWireSpecs plus the pass batch size (for
+    padded-row sizing), and coordinates the f32 wire's scan-constant
+    pre-centering shifts: decode cannot know them statically, so
+    shift-needing columns stay on the Column path until the FIRST
+    batch's pack resolves the shifts (resolve_shift, single prep thread)
+    and publishes them here; later batches then fuse with the exact
+    sticky shift. On the f64 wire no key shifts and the gate is open
+    from the start."""
+
+    def __init__(self, columns, batch_size: int):
+        import threading
+
+        self.columns = dict(columns)  # column -> ColumnWireSpec
+        self.batch_size = int(batch_size)
+        self.shifts: dict = {}
+        self._abandoned = False
+        self._pack_started = False
+        self._shift_ready = threading.Event()
+        if not any(s.needs_shift for s in self.columns.values()):
+            self._shift_ready.set()
+
+    @property
+    def shift_keys(self) -> List[str]:
+        return [
+            f"num:{c}" for c, s in self.columns.items() if s.needs_shift
+        ]
+
+    def mark_pack_started(self) -> None:
+        """The prep thread is about to pack a batch. Until this point a
+        shift_for wait is pure stall — nothing can possibly publish —
+        so decode workers return None immediately instead (the
+        first-batch fallback is by design). GIL-atomic bool write."""
+        self._pack_started = True
+
+    def publish_shifts(self, shifts: dict) -> None:
+        self.shifts.update(shifts)
+        self._shift_ready.set()
+
+    def abandon_shifts(self) -> None:
+        """The pack path died before resolving shifts (device failure):
+        shift-needing columns decode through the Column path forever."""
+        self._abandoned = True
+        self._shift_ready.set()
+
+    def shift_for(self, key: str, timeout: float = 0.25):
+        """The published sticky shift for a num: key, or None when not
+        (yet) available — the caller falls back to the Column path for
+        this batch and retries on the next. Non-blocking until the
+        first pack is underway (mark_pack_started): before that the
+        publish cannot happen, and waiting would serialize a full
+        timeout per shift-needing column into the first batch's decode.
+        Once a pack is in flight the short wait lets the overlapped
+        next batch catch the publish instead of falling back."""
+        if not self._shift_ready.is_set():
+            if not self._pack_started:
+                return None
+            if not self._shift_ready.wait(timeout):
+                return None
+        if self._abandoned:
+            return None
+        return float(self.shifts.get(key, 0.0))
+
+
 def decode_workers() -> int:
     """Number of parallel row-group decode workers
     (`DEEQU_TPU_DECODE_WORKERS`, default `min(cores, 4)`; 1 = the
@@ -438,6 +562,10 @@ def record_pruned_groups(skipped: int, total: int) -> None:
 
 def record_decode_fastpath(fast: int, total: int, workers: int) -> None:
     _counters.record_decode_fastpath(fast, total, workers)
+
+
+def record_wire_fused(fused: int, total: int) -> None:
+    _counters.record_wire_fused(fused, total)
 
 
 def pad_to(arr: np.ndarray, size: int) -> np.ndarray:

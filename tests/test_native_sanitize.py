@@ -124,6 +124,46 @@ rc = native.decode_dict_codes(
 )
 assert rc == d.null_count
 assert all(c == -1 for c, m in zip(out_c, out_cm) if not m)
+
+# decode-to-wire kernels on the same sliced odd-offset shapes: the
+# bitpacked output lands MID-BYTE (odd out_bit_offset) and the row
+# count ends off a byte boundary — exactly where an off-by-one reads
+# past the validity bitmap or writes past the mask tail
+wb = np.zeros(128, dtype=np.uint8)
+wv = np.zeros(len(f), dtype=np.float64)
+rcw = native.wire_primitive(
+    "double", bufs[1].address + f.offset * 8, bufs[0].address,
+    f.offset, len(f), 0.0, wv, wb, 5,
+)
+assert rcw == sum(v is None for v in f.to_pylist())
+wm = np.unpackbits(wb, count=5 + len(f))[5:].astype(bool)
+assert [v if m else None for v, m in zip(wv, wm)] == f.to_pylist()
+
+wv32 = np.zeros(len(f), dtype=np.float32)
+wb32 = np.zeros(128, dtype=np.uint8)
+rcs = native.wire_primitive(
+    "double", bufs[1].address + f.offset * 8, bufs[0].address,
+    f.offset, len(f), 500.25, wv32, wb32, 3,
+)
+assert rcs == rcw
+
+ia = pa.array(
+    [i % 120 if i % 4 else None for i in range(1003)], type=pa.int64()
+).slice(7, 900)
+iab = ia.buffers()
+wvi = np.zeros(len(ia), dtype=np.int8)
+wbi = np.zeros(128, dtype=np.uint8)
+rci = native.wire_primitive(
+    "int64", iab[1].address + ia.offset * 8, iab[0].address,
+    ia.offset, len(ia), 0.0, wvi, wbi, 1,
+)
+assert rci == sum(v is None for v in ia.to_pylist())
+im = np.unpackbits(wbi, count=1 + len(ia))[1:].astype(bool)
+assert [int(v) if m else None for v, m in zip(wvi, im)] == ia.to_pylist()
+
+wbv = np.zeros(128, dtype=np.uint8)
+rcv = native.wire_valid_bits(iab[0].address, ia.offset, len(ia), wbv, 9)
+assert rcv == rci
 print("SANITIZED_OK")
 """
 
@@ -223,6 +263,14 @@ shared_arrow = pa.array(
 ).slice(5, n)
 _ab = shared_arrow.buffers()
 
+# decode-to-wire concurrency shape: every thread reads the SAME arrow
+# buffers and packs its own disjoint byte-aligned slice of one shared
+# prezeroed bitmask (in the engine each batch's wire buffers have a
+# single writer; the sharing under test is the read side + disjoint
+# output bytes)
+N_SEG = n // N_THREADS  # byte-aligned: n and N_THREADS are powers of 2
+shared_wire_bits = np.zeros(n // 8, dtype=np.uint8)
+
 def work(seed):
     r = np.random.default_rng(seed)
     x = r.random(n)
@@ -250,6 +298,14 @@ def work(seed):
             _ab[0].address, shared_arrow.offset, len(shared_arrow), dv, dm,
         )
         assert rc == shared_arrow.null_count
+        off = seed * N_SEG
+        wv = np.zeros(N_SEG, dtype=np.float64)
+        rcw = native.wire_primitive(
+            "double", _ab[1].address + (shared_arrow.offset + off) * 8,
+            _ab[0].address, shared_arrow.offset + off, N_SEG, 0.0, wv,
+            shared_wire_bits, off,
+        )
+        assert rcw is not None and rcw >= 0
     # deterministic reference: same shared inputs -> same moments
     mom = native.masked_moments_select(
         shared_x, shared_valid, shared_where, cap=128
@@ -259,6 +315,10 @@ def work(seed):
 with ThreadPoolExecutor(max_workers=N_THREADS) as pool:
     results = list(pool.map(work, range(N_THREADS)))
 assert len(set(results)) == 1, "concurrent runs diverged: " + repr(results)
+expected_mask = np.array(shared_arrow.is_valid())
+assert np.array_equal(
+    np.unpackbits(shared_wire_bits, count=n).astype(bool), expected_mask
+), "shared wire bitmask diverged from the validity reference"
 print("TSAN_OK")
 """
 

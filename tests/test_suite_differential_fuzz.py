@@ -676,6 +676,73 @@ def test_decode_fastpath_workers_bit_identical(layout, seed, monkeypatch, tmp_pa
     )
 
 
+# -- decode-to-wire fusion on/off differential (ISSUE 9) ---------------------
+
+
+@pytest.mark.parametrize(
+    "layout,seed",
+    [(layout, seed) for layout in ("narrow", "wide", "lineitem") for seed in range(2)],
+)
+def test_wire_fusion_bit_identical(layout, seed, monkeypatch, tmp_path):
+    """DEEQU_TPU_WIRE_FUSED=0 (Column intermediate + numpy pack) vs =1
+    (decode straight into packed wire slices) must be BIT-identical —
+    exact snapshot equality, sketches included — across worker counts 1
+    vs 3 and BOTH placements: the wire kernels change where masks pack
+    and values narrow/shift, never one bit of any metric. Every layout
+    runs so bitpacked NaN folds, narrowed ints, f32 shift handshakes and
+    valid-only bool masks all cross both routes. Under a tracer the wire
+    verdict must actually have run (wire_cols_total counter recorded,
+    cols_wire_fused attr on the decode plan span)."""
+    from deequ_tpu import observe
+    from deequ_tpu.data.table import Table as TableCls
+
+    rng = np.random.default_rng(15_000 + seed)
+    table = LAYOUTS[layout](rng)
+    n = table.num_rows
+    roles = layout_roles(layout, rng)
+    checks = [random_check(rng, roles) for _ in range(int(rng.integers(1, 3)))]
+
+    path = str(tmp_path / "wire.parquet")
+    table.to_parquet(
+        path, row_group_size=max(64, n // 7), dictionary_encode_strings=True
+    )
+
+    def run(wire_env, workers_env, placement):
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+        monkeypatch.setenv("DEEQU_TPU_WIRE_FUSED", wire_env)
+        monkeypatch.setenv("DEEQU_TPU_DECODE_WORKERS", workers_env)
+        data = TableCls.scan_parquet(path, batch_rows=max(64, n // 5))
+        builder = VerificationSuite().on_data(data)
+        for check in checks:
+            builder = builder.add_check(check)
+        return suite_snapshot(builder.with_engine("single").run())
+
+    for placement in ("host", "device"):
+        baseline = run("0", "1", placement)
+        for wire, workers in (("1", "1"), ("0", "3"), ("1", "3")):
+            assert run(wire, workers, placement) == baseline, (
+                layout, seed, placement, wire, workers,
+            )
+
+    device_baseline = run("0", "1", "device")
+    with observe.tracing() as tracer:
+        traced = run("1", "3", "device")
+    assert traced == device_baseline, ("tracing changed results", layout, seed)
+    plans = [
+        sp
+        for root in tracer.roots
+        for sp in _iter_spans(root)
+        if sp.name == "decode_fastpath"
+    ]
+    assert plans, "decode planner never produced a plan"
+    assert all("cols_wire_fused" in sp.attrs for sp in plans), (
+        "wire verdict missing from the decode plan span"
+    )
+    assert tracer.counters.get("wire_cols_total", 0) > 0, (
+        "wire planning never recorded its verdict"
+    )
+
+
 @pytest.mark.parametrize(
     "layout,seed",
     [("wide", 0), ("wide", 1), ("lineitem", 0), ("lineitem", 1)],

@@ -1,0 +1,564 @@
+"""Decode-to-wire fusion (ISSUE 9): Arrow buffers straight to packed
+device wire, skipping the Column intermediate.
+
+Four layers are pinned here:
+  - the wire kernels: MSB bitpacking at non-multiple-of-8 row offsets
+    against the np.packbits reference, the one-pass NaN fold, f32
+    shift parity with `pack_batch_inputs`, and the narrowed-int
+    overflow -> None fallback contract;
+  - the decoder: `decode_wire_column` bit-identity of wire rows and
+    the WireStubColumn's lazy `.values`/`.valid` accessors against the
+    ordinary decode, across sliced odd-offset and multi-chunk inputs;
+  - the planner: `classify_wire_columns` eligibility and per-column
+    fall-off reasons (with the offending consumer key), static
+    narrow-int pinning from type bounds and file statistics;
+  - observability: the EXPLAIN `wire:` line, DQ313, the zero-drift
+    pin on wire_fused_cols, the `engine.wire_fused_ratio` telemetry
+    derivation, and the sentinel's watch list.
+
+The end-to-end fusion-on/off differential fuzz lives in
+tests/test_suite_differential_fuzz.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from deequ_tpu.data.source import ParquetSource
+from deequ_tpu.ops import native, runtime
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C compiler for the native kernels"
+)
+
+
+def _validity_addr(arr):
+    bufs = arr.buffers()
+    if arr.null_count == 0 or bufs[0] is None:
+        return None
+    return bufs[0].address
+
+
+def _expand(bits, n):
+    return np.unpackbits(bits, count=n).astype(np.bool_)
+
+
+class TestWireValidBits:
+    @pytest.mark.parametrize("out_off", [0, 1, 3, 7, 9, 13])
+    def test_packs_msb_first_at_odd_offsets(self, out_off):
+        # rows continue mid-byte in the shared bitmask exactly where the
+        # previous chunk stopped — the np.packbits reference is what
+        # pack_batch_inputs would have produced for the same mask
+        vals = [None if i % 3 == 0 else float(i) for i in range(21)]
+        arr = pa.array(vals, type=pa.float64())
+        out = np.zeros(8, dtype=np.uint8)
+        invalid = native.wire_valid_bits(
+            _validity_addr(arr), arr.offset, len(arr), out, out_off
+        )
+        mask = np.zeros(64, dtype=np.uint8)
+        mask[out_off : out_off + 21] = [v is not None for v in vals]
+        assert np.array_equal(out, np.packbits(mask)), out_off
+        assert invalid == sum(v is None for v in vals)
+
+    def test_sliced_odd_offset_input(self):
+        base = pa.array(
+            [None if i % 5 == 0 else float(i) for i in range(40)],
+            type=pa.float64(),
+        )
+        arr = base.slice(3, 29)  # bit_offset 3 into the validity bitmap
+        out = np.zeros(8, dtype=np.uint8)
+        invalid = native.wire_valid_bits(
+            _validity_addr(arr), arr.offset, len(arr), out, 0
+        )
+        ref = np.zeros(64, dtype=np.uint8)
+        ref[:29] = [(i + 3) % 5 != 0 for i in range(29)]
+        assert np.array_equal(out, np.packbits(ref))
+        assert invalid == int(29 - ref.sum())
+
+    def test_null_free_chunk_sets_every_bit(self):
+        arr = pa.array([1.0, 2.0, 3.0], type=pa.float64())
+        out = np.zeros(2, dtype=np.uint8)
+        invalid = native.wire_valid_bits(None, 0, 3, out, 5)
+        ref = np.zeros(16, dtype=np.uint8)
+        ref[5:8] = 1
+        assert np.array_equal(out, np.packbits(ref))
+        assert invalid == 0
+
+
+class TestWirePrimitive:
+    def test_f64_nan_folds_into_bits_and_zero(self):
+        vals = [1.5, None, float("nan"), -4.0, 0.25]
+        arr = pa.array(vals, type=pa.float64())
+        out_vals = np.zeros(8, dtype=np.float64)
+        out_bits = np.zeros(1, dtype=np.uint8)
+        invalid = native.wire_primitive(
+            "double",
+            arr.buffers()[1].address,
+            _validity_addr(arr),
+            arr.offset,
+            len(arr),
+            0.0,
+            out_vals,
+            out_bits,
+            0,
+        )
+        assert invalid == 2  # the null AND the NaN
+        assert np.array_equal(out_vals[:5], [1.5, 0.0, 0.0, -4.0, 0.25])
+        assert np.array_equal(
+            _expand(out_bits, 5), [True, False, False, True, True]
+        )
+
+    def test_f32_shift_parity_with_pack(self):
+        # the wire kernel computes (float)((double)v - shift); the pack
+        # path subtracts the shift in f64 then astypes — bit-identical
+        rng = np.random.default_rng(5)
+        raw = rng.normal(1.0e6, 3.0, 64)
+        raw[7] = np.nan
+        arr = pa.array(raw, type=pa.float64())
+        shift = float(raw[0])
+        out_vals = np.zeros(64, dtype=np.float32)
+        out_bits = np.zeros(8, dtype=np.uint8)
+        rc = native.wire_primitive(
+            "double",
+            arr.buffers()[1].address,
+            _validity_addr(arr),
+            arr.offset,
+            len(arr),
+            shift,
+            out_vals,
+            out_bits,
+            0,
+        )
+        assert rc == 1
+        folded = np.where(np.isnan(raw), 0.0, raw)
+        ref = (folded - shift).astype(np.float32)
+        assert out_vals.tobytes() == ref.tobytes()
+
+    @pytest.mark.parametrize(
+        "out_dtype,fits",
+        [("int8", 127), ("int16", 32767), ("int32", 2**31 - 1)],
+    )
+    def test_narrowed_int_exact_and_overflow_none(self, out_dtype, fits):
+        ok = pa.array([0, 1, -(fits // 2), fits, None], type=pa.int64())
+        out_vals = np.zeros(8, dtype=np.dtype(out_dtype))
+        out_bits = np.zeros(1, dtype=np.uint8)
+        rc = native.wire_primitive(
+            "int64",
+            ok.buffers()[1].address,
+            _validity_addr(ok),
+            ok.offset,
+            len(ok),
+            0.0,
+            out_vals,
+            out_bits,
+            0,
+        )
+        assert rc == 1
+        assert np.array_equal(out_vals[:5], [0, 1, -(fits // 2), fits, 0])
+
+        # one row past the pinned width: the kernel refuses the whole
+        # chunk (rc < 0 -> wrapper None) and the caller falls back
+        over = pa.array([0, fits + 1], type=pa.int64())
+        rc = native.wire_primitive(
+            "int64",
+            over.buffers()[1].address,
+            None,
+            0,
+            len(over),
+            0.0,
+            np.zeros(8, dtype=np.dtype(out_dtype)),
+            None,
+            0,
+        )
+        assert rc is None
+
+    def test_int_to_f64_value_row(self):
+        arr = pa.array([5, None, -9], type=pa.int32())
+        out_vals = np.zeros(8, dtype=np.float64)
+        rc = native.wire_primitive(
+            "int32",
+            arr.buffers()[1].address,
+            _validity_addr(arr),
+            arr.offset,
+            len(arr),
+            0.0,
+            out_vals,
+            None,
+            0,
+        )
+        assert rc == 1
+        assert np.array_equal(out_vals[:3], [5.0, 0.0, -9.0])
+
+    def test_unsupported_pair_returns_none(self):
+        assert not native.wire_supported("uint64", "float64")
+        assert native.wire_supported("double", "float32")
+        assert native.wire_supported("int64", "int8")
+
+
+def _wire_plan(specs, batch_size=256):
+    return runtime.WireFusionPlan(specs, batch_size)
+
+
+def _spec(**kw):
+    base = dict(
+        column="x",
+        token="double",
+        want_value=True,
+        want_valid=True,
+        value_kind="val",
+        value_dtype="float64",
+        needs_shift=False,
+        desc="f64",
+    )
+    base.update(kw)
+    return runtime.ColumnWireSpec(**base)
+
+
+class TestDecodeWireColumn:
+    def test_multi_chunk_odd_lengths_cross_byte_boundaries(self):
+        from deequ_tpu.data.arrow_decode import decode_wire_column
+
+        rng = np.random.default_rng(9)
+        parts = []
+        for m in (13, 7, 11):  # chunk ends off every byte boundary
+            vals = rng.normal(0, 1, m)
+            vals[0] = np.nan
+            parts.append(
+                pa.array(
+                    [None if i % 4 == 2 else v for i, v in enumerate(vals)],
+                    type=pa.float64(),
+                )
+            )
+        chunks = [parts[0], parts[1].slice(1, 5), parts[2]]
+        t = pa.table({"x": pa.chunked_array(chunks)})
+        spec = _spec()
+        wire = _wire_plan({"x": spec})
+        out = decode_wire_column("x", chunks, t, spec, wire)
+        assert out is not None
+        stub, rows = out
+        n = sum(len(c) for c in chunks)
+
+        # reference: null/NaN fold over the very same chunks
+        raw = np.concatenate(
+            [
+                np.asarray(c.to_numpy(zero_copy_only=False), dtype=np.float64)
+                for c in chunks
+            ]
+        )
+        present = np.concatenate([np.asarray(c.is_valid()) for c in chunks])
+        ref_valid = present & ~np.isnan(np.where(present, raw, 0.0))
+        ref_vals = np.where(ref_valid, raw, 0.0)
+        num = rows["num:x"]
+        assert np.array_equal(num.arr[:n], ref_vals)
+        bits = rows["valid:x"]
+        assert np.array_equal(_expand(bits.arr, n), ref_valid)
+        # pad tail stays zero (the OFF path's zeroed group buffer)
+        tail = _expand(bits.arr, len(bits.arr) * 8)[n:]
+        assert not tail.any()
+
+        # the stub's lazy accessors rebuild bit-identical host data
+        assert len(stub) == n
+        assert np.array_equal(np.asarray(stub.valid), ref_valid)
+        assert np.array_equal(
+            np.asarray(stub.values), np.where(ref_valid, ref_vals, 0.0)
+        )
+
+    def test_shift_unavailable_falls_back_this_batch(self):
+        from deequ_tpu.data.arrow_decode import decode_wire_column
+
+        arr = pa.array([1.0, 2.0], type=pa.float64())
+        t = pa.table({"x": arr})
+        spec = _spec(value_dtype="float32", needs_shift=True, desc="f32+shift")
+        wire = _wire_plan({"x": spec})
+        assert decode_wire_column("x", [arr], t, spec, wire) is None
+
+        wire.publish_shifts({"num:x": 1.0})
+        out = decode_wire_column("x", [arr], t, spec, wire)
+        assert out is not None
+        _, rows = out
+        assert rows["num:x"].shift == 1.0
+        assert np.array_equal(rows["num:x"].arr[:2], [0.0, 1.0])
+
+        wire2 = _wire_plan({"x": spec})
+        wire2.abandon_shifts()
+        assert decode_wire_column("x", [arr], t, spec, wire2) is None
+
+    def test_narrow_overflow_falls_back_this_batch(self):
+        from deequ_tpu.data.arrow_decode import decode_wire_column
+
+        arr = pa.array([1, 2, 300], type=pa.int64())
+        t = pa.table({"i": arr})
+        spec = _spec(
+            column="i", token="int64", value_kind="ival", value_dtype="int8",
+            desc="i8",
+        )
+        wire = _wire_plan({"i": spec})
+        assert decode_wire_column("i", [arr], t, spec, wire) is None
+
+    def test_valid_only_bool_column(self):
+        from deequ_tpu.data.arrow_decode import decode_wire_column
+
+        arr = pa.array([True, None, False, True, None])
+        t = pa.table({"b": arr})
+        spec = _spec(
+            column="b", token="bool", want_value=False, value_kind="",
+            value_dtype="", desc="bits",
+        )
+        wire = _wire_plan({"b": spec})
+        out = decode_wire_column("b", [arr], t, spec, wire)
+        assert out is not None
+        _, rows = out
+        assert set(rows) == {"valid:b"}
+        assert np.array_equal(
+            _expand(rows["valid:b"].arr, 5), [True, False, True, True, False]
+        )
+        assert not rows["valid:b"].all_valid
+
+
+class TestClassifier:
+    def _specs(self, keys):
+        from deequ_tpu.analyzers.base import InputSpec
+
+        out = {}
+        for key in keys:
+            col = key.split(":", 1)[1]
+            out[key] = InputSpec(key=key, build=None, columns=(col,))
+        return out
+
+    def test_packed_only_columns_fuse(self):
+        from deequ_tpu.ops.fused import classify_wire_columns
+
+        specs = self._specs(["num:x", "valid:x", "valid:b"])
+        wire, falloffs = classify_wire_columns(
+            {"x": "double", "b": "bool"},
+            specs,
+            {"num:x", "valid:x", "valid:b"},
+            "float64",
+        )
+        assert set(wire) == {"x", "b"}
+        assert wire["x"].value_kind == "val"
+        assert wire["x"].value_dtype == "float64"
+        assert not wire["x"].needs_shift
+        assert not wire["b"].want_value
+        assert falloffs == []
+
+    def test_f32_wire_needs_shift(self):
+        from deequ_tpu.ops.fused import classify_wire_columns
+
+        specs = self._specs(["num:x"])
+        wire, _ = classify_wire_columns(
+            {"x": "double"}, specs, {"num:x"}, "float32"
+        )
+        assert wire["x"].needs_shift
+        assert wire["x"].value_dtype == "float32"
+
+    def test_off_wire_consumer_names_offending_key(self):
+        from deequ_tpu.ops.fused import classify_wire_columns
+
+        specs = self._specs(["num:x", "valid:x"])
+        wire, falloffs = classify_wire_columns(
+            {"x": "double"}, specs, {"valid:x"}, "float64"
+        )
+        assert wire == {}
+        (col, reason, key) = falloffs[0]
+        assert col == "x" and key == "num:x" and "off-wire" in reason
+
+    def test_non_pack_consumer_names_offending_key(self):
+        from deequ_tpu.ops.fused import classify_wire_columns
+
+        specs = self._specs(["num:x", "raw:x"])
+        _, falloffs = classify_wire_columns(
+            {"x": "double"}, specs, {"num:x", "raw:x"}, "float64"
+        )
+        (col, reason, key) = falloffs[0]
+        assert col == "x" and key == "raw:x"
+
+    def test_uint64_and_bool_values_fall_off(self):
+        from deequ_tpu.ops.fused import classify_wire_columns
+
+        specs = self._specs(["num:u", "num:b", "valid:b"])
+        wire, falloffs = classify_wire_columns(
+            {"u": "uint64", "b": "bool"},
+            specs,
+            {"num:u", "num:b", "valid:b"},
+            "float64",
+        )
+        assert wire == {}
+        reasons = {c: r for c, r, _ in falloffs}
+        assert "uint64" in reasons["u"]
+        assert "astype" in reasons["b"]
+
+    def test_int_pinning_from_bounds_and_type(self):
+        from deequ_tpu.ops.fused import (
+            _pin_int_wire_width,
+            classify_wire_columns,
+        )
+
+        assert _pin_int_wire_width("int64", None) is None  # full range
+        assert _pin_int_wire_width("int64", (0, 100)) == "int8"
+        assert _pin_int_wire_width("int64", (-200, 300)) == "int16"
+        assert _pin_int_wire_width("int64", (5, 10)) == "int8"  # widens to 0
+        assert _pin_int_wire_width("int16", None) == "int16"  # type bounds
+        assert _pin_int_wire_width("uint32", None) is None
+
+        specs = self._specs(["num:i"])
+        wire, _ = classify_wire_columns(
+            {"i": "int64"}, specs, {"num:i"}, "float64",
+            int_bounds={"i": (0, 90)},
+        )
+        assert wire["i"].value_kind == "ival"
+        assert wire["i"].value_dtype == "int8"
+        wire, _ = classify_wire_columns(
+            {"i": "int64"}, specs, {"num:i"}, "float64"
+        )
+        assert wire["i"].value_kind == "val"
+        assert wire["i"].value_dtype == "float64"
+
+
+def _write_numeric_parquet(tmp_path, n=6000, row_group=700):
+    rng = np.random.default_rng(21)
+    x = rng.normal(50.0, 4.0, n)
+    x[::61] = np.nan
+    t = pa.table(
+        {
+            "x": pa.array(x, type=pa.float64()),
+            "i": pa.array(rng.integers(-100, 120, n), type=pa.int64()),
+            "b": pa.array(rng.random(n) > 0.4),
+            "s": pa.array(["k%d" % (k % 30) for k in range(n)]),
+        }
+    )
+    path = str(tmp_path / "wire.parquet")
+    pq.write_table(t, path, row_group_size=row_group)
+    return path
+
+
+def _analyzers():
+    from deequ_tpu.analyzers import Completeness, Mean, StandardDeviation
+
+    return [
+        Mean("x"),
+        StandardDeviation("x"),
+        Completeness("x"),
+        Mean("i"),
+        Completeness("b"),
+        Completeness("s"),
+    ]
+
+
+class TestEndToEnd:
+    def test_fusion_engages_and_shift_handshake_converges(
+        self, tmp_path, monkeypatch
+    ):
+        from deequ_tpu import observe
+        from deequ_tpu.runners import AnalysisRunner
+
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "device")
+        monkeypatch.setenv("DEEQU_TPU_DECODE_WORKERS", "1")
+        path = _write_numeric_parquet(tmp_path)
+        with observe.tracing() as tracer:
+            AnalysisRunner().on_data(
+                ParquetSource(path, batch_rows=1400)
+            ).add_analyzers(_analyzers()).run()
+
+        def spans(root):
+            stack = [root]
+            while stack:
+                sp = stack.pop()
+                yield sp
+                stack.extend(sp.children)
+
+        decodes = [
+            sp
+            for root in tracer.roots
+            for sp in spans(root)
+            if sp.name == "arrow_decode" and "wire_fuse" in sp.attrs
+        ]
+        assert decodes, "no arrow_decode span carried the wire_fuse attr"
+        fused_counts = [sp.attrs["wire_fuse"] for sp in decodes]
+        # every batch fuses at least the valid-only bool column; once
+        # the pack publishes the sticky shifts (f32 wire) or from batch
+        # 0 outright (f64 wire), all three numeric columns fuse
+        assert max(fused_counts) == 3, fused_counts
+        assert min(fused_counts) >= 1, fused_counts
+        assert tracer.counters["wire_fused_cols"] == 3
+        assert tracer.counters["wire_cols_total"] == 4
+
+    def test_kill_switch_disables_fusion(self, tmp_path, monkeypatch):
+        from deequ_tpu import observe
+        from deequ_tpu.runners import AnalysisRunner
+
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "device")
+        monkeypatch.setenv("DEEQU_TPU_WIRE_FUSED", "0")
+        path = _write_numeric_parquet(tmp_path)
+        with observe.tracing() as tracer:
+            AnalysisRunner().on_data(
+                ParquetSource(path, batch_rows=1400)
+            ).add_analyzers(_analyzers()).run()
+        assert tracer.counters.get("wire_fused_cols", 0) == 0
+        assert tracer.counters["wire_cols_total"] == 4
+
+    def test_explain_pins_to_trace_with_zero_drift(self, tmp_path, monkeypatch):
+        from deequ_tpu.lint.cost import cost_drift
+        from deequ_tpu.lint.explain import explain_plan
+        from deequ_tpu.observe.runtrace import traced_run
+        from deequ_tpu.runners import AnalysisRunner
+
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "device")
+        path = _write_numeric_parquet(tmp_path)
+        analyzers = _analyzers()
+        res = explain_plan(ParquetSource(path, batch_rows=1400), analyzers)
+        scan = res.cost.scan_pass
+        assert scan.wire_fused_cols == 3
+        assert scan.saved_pack_bytes and scan.saved_pack_bytes > 0
+        rendered = res.render()
+        assert "wire: 3/4 column(s) fused at decode" in rendered
+
+        with traced_run("t", enable=True) as handle:
+            AnalysisRunner().on_data(
+                ParquetSource(path, batch_rows=1400)
+            ).add_analyzers(analyzers).run()
+        drift = cost_drift(res.cost, handle.trace)
+        assert drift["drift.wire_fused_cols"] == 0.0
+
+    def test_dq313_carets_offending_consumer_key(self, tmp_path, monkeypatch):
+        from deequ_tpu.analyzers import ApproxQuantile, Mean
+        from deequ_tpu.lint.explain import explain_plan
+
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "device")
+        path = _write_numeric_parquet(tmp_path)
+        res = explain_plan(
+            ParquetSource(path, batch_rows=1400),
+            [Mean("x"), ApproxQuantile("x", 0.5), Mean("i")],
+        )
+        d313 = [d for d in res.diagnostics if d.code == "DQ313"]
+        assert d313, "assisted re-read produced no DQ313"
+        assert any(d.source == "num:x" and d.span == (0, 5) for d in d313)
+
+    def test_telemetry_ratio_and_sentinel_watch(self, tmp_path, monkeypatch):
+        from deequ_tpu.observe.runtrace import traced_run
+        from deequ_tpu.observe.telemetry import engine_metric_record
+        from deequ_tpu.runners import AnalysisRunner
+
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "device")
+        path = _write_numeric_parquet(tmp_path)
+        with traced_run("t", enable=True) as handle:
+            AnalysisRunner().on_data(
+                ParquetSource(path, batch_rows=1400)
+            ).add_analyzers(_analyzers()).run()
+        rec = engine_metric_record(handle.trace)
+        assert rec["engine.wire_fused_ratio"] == 0.75
+
+        import importlib.util
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "sentinel", os.path.join(repo, "tools", "sentinel.py")
+        )
+        sentinel = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(sentinel)
+        watched = dict(sentinel.WATCHED_SERIES)
+        assert watched.get("engine.wire_fused_ratio") == "down"

@@ -103,6 +103,12 @@ DECODE_FILES = [
     os.path.join("deequ_tpu", "ops", "native", "__init__.py"),
 ]
 DECODE_FORBIDDEN_ATTRS = {"to_numpy", "frombuffer"}
+# Host pack idioms banned inside the decode-to-wire fused path (any
+# function or class whose name contains `wire`): the wire kernels emit
+# packed bits and shifted/narrowed values directly, so a packbits/astype
+# there means the serial numpy pack crept back in. Designated
+# `*_fallback` functions stay exempt — they ARE the host re-read.
+DECODE_WIRE_FORBIDDEN_ATTRS = {"packbits", "astype"}
 GLOBALMUT_MUTATORS = {
     "append",
     "extend",
@@ -298,30 +304,49 @@ def check_decode_copies(path: str) -> List[str]:
     function whose name ends `_fallback`). The fast path exists to
     replace exactly these per-column host copies with one native pass
     over the arrow buffers; host materialization belongs in the
-    designated fallbacks (e.g. table.py's _column_from_arrow_fallback)."""
+    designated fallbacks (e.g. table.py's _column_from_arrow_fallback).
+
+    Inside the decode-to-wire fused path (functions/classes named
+    `*wire*`) the rule additionally bans the `.packbits(...)` /
+    `.astype(...)` pack idioms: the wire kernels already emit packed
+    bits and shifted/narrowed values, so those calls mean the serial
+    numpy pack crept back in. `*_fallback` functions stay exempt."""
     with open(path, encoding="utf-8") as f:
         tree = ast.parse(f.read(), filename=path)
     findings: List[str] = []
 
-    def walk(node: ast.AST, in_fallback: bool) -> None:
+    def walk(node: ast.AST, in_fallback: bool, in_wire: bool) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             in_fallback = in_fallback or node.name.endswith("_fallback")
+            in_wire = in_wire or "wire" in node.name.lower()
+        elif isinstance(node, ast.ClassDef):
+            in_wire = in_wire or "wire" in node.name.lower()
         if (
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
-            and node.func.attr in DECODE_FORBIDDEN_ATTRS
             and not in_fallback
         ):
-            findings.append(
-                f"{_rel(path)}:{node.lineno}: DECODE `.{node.func.attr}(...)` "
-                f"in a fast-path decode module — this is the host copy the "
-                f"fast path removes; decode via the native kernels, or move "
-                f"the copy into a designated `*_fallback` function"
-            )
+            if node.func.attr in DECODE_FORBIDDEN_ATTRS:
+                findings.append(
+                    f"{_rel(path)}:{node.lineno}: DECODE "
+                    f"`.{node.func.attr}(...)` in a fast-path decode module "
+                    f"— this is the host copy the fast path removes; decode "
+                    f"via the native kernels, or move the copy into a "
+                    f"designated `*_fallback` function"
+                )
+            elif in_wire and node.func.attr in DECODE_WIRE_FORBIDDEN_ATTRS:
+                findings.append(
+                    f"{_rel(path)}:{node.lineno}: DECODE "
+                    f"`.{node.func.attr}(...)` in the decode-to-wire fused "
+                    f"path — the wire kernels already pack bits and "
+                    f"narrow/shift values; re-packing on the host defeats "
+                    f"the fusion. Move the copy into a designated "
+                    f"`*_fallback` function"
+                )
         for child in ast.iter_child_nodes(node):
-            walk(child, in_fallback)
+            walk(child, in_fallback, in_wire)
 
-    walk(tree, False)
+    walk(tree, False, False)
     return findings
 
 

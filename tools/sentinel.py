@@ -57,6 +57,11 @@ WATCHED_SERIES: Sequence[Tuple[str, str]] = (
     # per-scan decode worker count; a drop means the pool stopped
     # scaling (env override lost, cpu_count misdetected)
     ("engine.decode_workers", "down"),
+    # decode-to-wire effectiveness: the fraction of scanned columns fused
+    # straight to wire buffers at decode; a drop means columns fell back
+    # to the Column path (consumer set widened, sticky spec lost, wire
+    # kernels unavailable)
+    ("engine.wire_fused_ratio", "down"),
 )
 
 #: phases whose share of wall time is watched (rises are bad: a phase
