@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint typecheck analyze sentinel test test-fast trace-demo bench-pushdown bench-decode bench-wire
+.PHONY: lint typecheck analyze sentinel test test-fast trace-demo bench-pushdown bench-decode bench-wire bench-incremental
 
 lint:
 	$(PY) tools/lint.py
@@ -56,6 +56,16 @@ bench-decode:
 BENCH_WIRE_ROWS ?= 4000000
 bench-wire:
 	JAX_PLATFORMS=cpu BENCH_MODE=wire BENCH_ROWS=$(BENCH_WIRE_ROWS) $(PY) bench.py
+
+# persistent partition-state cache A/B: cold full scan fills the
+# repository, one partition is appended, then a cache-off full rescan
+# races the warm incremental pass (cached loads + 1 scanned partition).
+# Aborts unless metrics are bit-identical and the trace pins exactly one
+# partition scanned. Refreshes BENCH_INCREMENTAL.json (methodology:
+# BENCH.md round 11)
+BENCH_INCREMENTAL_ROWS ?= 6000000
+bench-incremental:
+	JAX_PLATFORMS=cpu BENCH_MODE=incremental BENCH_ROWS=$(BENCH_INCREMENTAL_ROWS) $(PY) bench.py
 
 test: lint
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q

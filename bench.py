@@ -37,6 +37,15 @@ Knobs (env):
                     dropped before each timed pass; decode self-seconds
                     come from traced warm passes. Refreshes
                     BENCH_DECODE.json
+                    incremental = persistent partition-state cache A/B
+                    (BENCH_INCREMENTAL.json, BENCH.md round 11): cold
+                    full scan fills the repository, ONE partition is
+                    appended, then a cache-off full rescan races the
+                    warm incremental pass that loads every unchanged
+                    partition's states and scans only the new file;
+                    aborts unless metrics are bit-identical and exactly
+                    one partition scanned. BENCH_INCR_PARTS sets the
+                    partition count (default 12, min 10)
     BENCH_TIMED     timed repetitions, best-of (default 5: shared-vCPU
                      boxes show 20-30% run-to-run noise; best-of-5 reads
                      the machine's actual capability. Compile happens
@@ -1041,6 +1050,212 @@ def run_wire_bench(n_rows: int) -> None:
     print(json.dumps(rec))
 
 
+def write_incremental_dataset(n_rows: int, n_parts: int, dir_path: str) -> None:
+    """A partitioned dataset (one parquet file per partition) with
+    deterministic per-partition contents: two doubles (one with NaN
+    holes), one long. Partition i is a pure function of i, so appending
+    part N later never perturbs parts 0..N-1."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(dir_path, exist_ok=True)
+    per_part = max(1, n_rows // n_parts)
+    for i in range(n_parts):
+        path = os.path.join(dir_path, f"part-{i:04d}.parquet")
+        if os.path.exists(path):
+            continue
+        rng = np.random.default_rng(1_000 + i)
+        x = rng.normal(float(i), 10.0, per_part)
+        x[rng.random(per_part) < 0.05] = np.nan
+        table = pa.table(
+            {
+                "x": x,
+                "y": x * 0.5 + rng.normal(0.0, 1.0, per_part),
+                "g": rng.integers(0, 10_000, per_part),
+            }
+        )
+        pq.write_table(table, path, row_group_size=max(4096, per_part // 8))
+
+
+def incremental_analyzers():
+    """Every cacheable scan family: counts, moments, HLL, KLL."""
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        ApproxQuantile,
+        Completeness,
+        Maximum,
+        Mean,
+        Minimum,
+        Size,
+        StandardDeviation,
+    )
+
+    return [
+        Size(),
+        Completeness("x"),
+        Mean("x"),
+        StandardDeviation("x"),
+        Minimum("x"),
+        Maximum("y"),
+        ApproxCountDistinct("g"),
+        ApproxQuantile("x", 0.5),
+    ]
+
+
+def run_incremental_bench(n_rows: int) -> None:
+    """BENCH_MODE=incremental: A/B the persistent partition-state cache
+    (ISSUE 10) on an N-partition dataset. Cold pass: full scan with an
+    empty state repository (fills it). Then ONE partition is appended
+    and the warm incremental pass — which loads N cached partition
+    states and scans only the new file — races a cache-off full rescan
+    of the same N+1 partitions. A separate traced pass (against a
+    pristine copy of the cold cache) proves partitions_scanned == 1;
+    all timed passes are warm-jit, cold-IO, untraced. Aborts on any
+    metric mismatch between the incremental merge and the full rescan.
+    Refreshes BENCH_INCREMENTAL.json (round/config preserved)."""
+    import shutil
+
+    from deequ_tpu import observe
+    from deequ_tpu.data.table import Table
+    from deequ_tpu.repository.states import FileSystemStateRepository
+    from deequ_tpu.runners.analysis_runner import AnalysisRunner
+
+    n_parts = max(10, int(os.environ.get("BENCH_INCR_PARTS", "12")))
+    data_dir = os.environ.get("BENCH_INCR_DIR", "/tmp/bench_incremental")
+    appended = os.path.join(data_dir, f"part-{n_parts:04d}.parquet")
+
+    t_gen = time.perf_counter()
+    if os.path.exists(appended):
+        os.remove(appended)  # a previous run's appended partition
+    write_incremental_dataset(n_rows, n_parts, data_dir)
+    gen_s = time.perf_counter() - t_gen
+
+    analyzers = incremental_analyzers()
+    os.environ["DEEQU_TPU_PLACEMENT"] = "device"
+    os.environ.pop("DEEQU_TPU_STATE_CACHE", None)
+
+    cache_dir = os.path.join(data_dir, "state-cache")
+    proof_dir = os.path.join(data_dir, "state-cache-proof")
+    for d in (cache_dir, proof_dir):
+        shutil.rmtree(d, ignore_errors=True)
+
+    def run_once(repository=None, tracing=None):
+        context = AnalysisRunner.do_analysis_run(
+            Table.scan_parquet_dataset(data_dir, batch_rows=1 << 20),
+            analyzers,
+            state_repository=repository,
+            dataset_name="bench",
+            tracing=tracing,
+        )
+        snapshot = {}
+        for analyzer, metric in context.metric_map.items():
+            v = (
+                metric.value.get()
+                if metric.value.is_success
+                else type(metric.value.exception).__name__
+            )
+            if isinstance(v, float) and v != v:
+                v = "nan"  # nan != nan would defeat the A/B comparison
+            snapshot[repr(analyzer)] = v
+        return snapshot, context
+
+    # warm-up (no repository): jit + imports, never timed
+    warm_snapshot, _ = run_once()
+
+    # cold pass: full scan, fills the empty repository
+    repo = FileSystemStateRepository(cache_dir)
+    cache_dropped = _drop_page_cache()
+    t0 = time.perf_counter()
+    cold_snapshot, _ = run_once(repository=repo)
+    cold_s = time.perf_counter() - t0
+
+    # the increment: ONE new partition appears
+    write_incremental_dataset(
+        n_rows + max(1, n_rows // n_parts), n_parts + 1, data_dir
+    )
+    # pristine copy of the cold cache for the traced proof pass, so the
+    # timed incremental pass still sees the appended partition as new
+    shutil.copytree(cache_dir, proof_dir)
+
+    # cache-off full rescan of the grown dataset (the A side)
+    _drop_page_cache()
+    t0 = time.perf_counter()
+    full_snapshot, _ = run_once()
+    full_s = time.perf_counter() - t0
+
+    # warm incremental pass (the B side): N cached loads + 1 scan
+    _drop_page_cache()
+    t0 = time.perf_counter()
+    incr_snapshot, _ = run_once(repository=repo)
+    incr_s = time.perf_counter() - t0
+
+    # traced proof pass against the pristine cache copy
+    proof_snapshot, proof_context = run_once(
+        repository=FileSystemStateRepository(proof_dir), tracing=True
+    )
+    counters = proof_context.run_trace.counters
+
+    if not (
+        warm_snapshot == cold_snapshot
+        and full_snapshot == incr_snapshot == proof_snapshot
+    ):
+        raise SystemExit(
+            "incremental A/B: metric mismatch between the cached merge "
+            f"and the full rescan\nfull: {full_snapshot}\nincr: {incr_snapshot}"
+        )
+    if counters.get("partitions_scanned") != 1:
+        raise SystemExit(
+            "incremental A/B: expected exactly 1 partition scanned, "
+            f"trace says {dict(counters)}"
+        )
+
+    speedup = full_s / incr_s if incr_s > 0 else float("inf")
+    rec = {
+        "metric": "incremental_speedup",
+        "value": round(speedup, 1),
+        "unit": "x",
+        "rows": n_rows,
+        "incremental_ab": {
+            "n_partitions": n_parts + 1,
+            "partitions_scanned": int(counters.get("partitions_scanned", 0)),
+            "partitions_cached": int(counters.get("partitions_cached", 0)),
+            "cold_s": round(cold_s, 2),
+            "full_rescan_s": round(full_s, 2),
+            "incremental_s": round(incr_s, 2),
+            "speedup_vs_full_rescan": round(speedup, 1),
+            "speedup_vs_cold": round(cold_s / incr_s, 1) if incr_s > 0 else None,
+            "bit_identical": True,
+            "page_cache_dropped": cache_dropped,
+            "passes": (
+                "untimed warm-up; cold fill pass; append 1 partition; "
+                "cache-off full rescan vs warm incremental, both "
+                "warm-jit cold-IO untraced; traced proof pass against "
+                "a pristine cache copy pins partitions_scanned == 1"
+            ),
+        },
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "BENCH_INCREMENTAL.json")
+    try:
+        with open(out_path) as fh:
+            old = json.load(fh)
+        for key in ("round", "config"):
+            if key in old and key not in rec:
+                rec[key] = old[key]
+    except Exception:  # noqa: BLE001 - first write: no fields to carry
+        pass
+    with open(out_path, "w") as fh:
+        json.dump(rec, fh)
+        fh.write("\n")
+    print(
+        f"# bench: incremental A/B full={full_s:.2f}s incr={incr_s:.2f}s "
+        f"({speedup:.1f}x), scanned {counters.get('partitions_scanned')}/"
+        f"{n_parts + 1} partitions (cold fill {cold_s:.2f}s); gen={gen_s:.1f}s",
+        file=sys.stderr,
+    )
+    print(json.dumps(rec))
+
+
 def _stream_shape() -> str:
     return os.environ.get("BENCH_STREAM_SHAPE", "default")
 
@@ -1384,6 +1599,11 @@ def main() -> None:
     if mode == "wire":
         # self-contained A/B with its own JSON record and artifact
         run_wire_bench(n_rows)
+        return
+
+    if mode == "incremental":
+        # self-contained A/B with its own JSON record and artifact
+        run_incremental_bench(n_rows)
         return
 
     t_gen = time.perf_counter()

@@ -531,6 +531,10 @@ def _serialize_kll(digest) -> bytes:
     for level in levels:
         parts.append(struct.pack(">i", len(level)))
         parts.append(np.asarray(level, dtype=">f8").tobytes())
+    # trailing generator position: KLL merges draw compaction offsets
+    # from the sketch's own rng, so restoring it is what makes a
+    # deserialized partial merge bit-identically to the live sketch
+    parts.append(digest.rng_state_bytes())
     return b"".join(parts)
 
 
@@ -548,4 +552,8 @@ def _deserialize_kll(data: bytes):
         )
         offset += 8 * length
         levels.append(level)
-    return KLLSketch.from_arrays(k, n, levels)
+    sketch = KLLSketch.from_arrays(k, n, levels)
+    tail = data[offset:]
+    if len(tail) == KLLSketch.RNG_STATE_LEN:
+        sketch.set_rng_state_bytes(tail)
+    return sketch

@@ -870,6 +870,24 @@ class Table:
 
         return ParquetSource(path, columns=columns, batch_rows=batch_rows)
 
+    @staticmethod
+    def scan_parquet_dataset(
+        paths,
+        columns: Optional[List[str]] = None,
+        batch_rows: int = 1 << 22,
+    ):
+        """Out-of-core scan over a directory (or explicit list) of
+        parquet partition files, folded one partition at a time and
+        merged through the analyzer state semigroup in deterministic
+        name order. The shape incremental runs require: attach a
+        `StateRepository` (`AnalysisRunBuilder.with_state_repository`)
+        and re-runs scan only new or modified partitions."""
+        from deequ_tpu.data.source import PartitionedParquetSource
+
+        return PartitionedParquetSource(
+            paths, columns=columns, batch_rows=batch_rows
+        )
+
     # -- schema / access ----------------------------------------------------
 
     @property

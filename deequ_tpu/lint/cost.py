@@ -122,6 +122,14 @@ class PassCost:
     wire_fused_cols: Optional[int] = None
     wire_falloffs: Tuple[Tuple[str, str, str], ...] = ()
     saved_pack_bytes: Optional[float] = None
+    #: partition-state-cache prediction (partitioned parquet sources
+    #: only): partitions in the dataset / partitions whose states will
+    #: load from the attached StateRepository instead of scanning / file
+    #: bytes those cached partitions would have read+decoded. None = the
+    #: source is not partitioned.
+    partitions_total: Optional[int] = None
+    partitions_cached: Optional[int] = None
+    saved_partition_bytes: Optional[float] = None
     family_groups: Tuple[FamilyGroupCost, ...] = ()
     #: grouping passes: estimated distinct-group count (product of
     #: `approx_distinct` hints); None when any hint is missing
@@ -301,6 +309,19 @@ def cost_drift(cost: "PlanCost", trace: Any) -> Dict[str, float]:
                 int(trace.counters.get("wire_fused_cols", 0))
                 - scan.wire_fused_cols
             )
+        if (
+            scan.partitions_cached is not None
+            and scan.partitions_total is not None
+            and "partitions_total" in trace.counters
+        ):
+            out["drift.partitions_cached"] = float(
+                int(trace.counters.get("partitions_cached", 0))
+                - scan.partitions_cached
+            )
+            out["drift.partitions_scanned"] = float(
+                int(trace.counters.get("partitions_scanned", 0))
+                - (scan.partitions_total - scan.partitions_cached)
+            )
     return out
 
 
@@ -387,6 +408,7 @@ def analyze_plan(
     pipeline_depth: Optional[int] = None,
     row_groups: Optional[Sequence[Any]] = None,
     decode_types: Optional[Dict[str, str]] = None,
+    partitions: Optional[Sequence[Any]] = None,
 ) -> PlanCost:
     """Abstract interpretation of `AnalysisRunner._do_analysis_run`:
     dedupe -> static precondition filtering (zero-row table) ->
@@ -414,7 +436,14 @@ def analyze_plan(
     the buffer-level native decode will take, the per-column fallback
     reasons, and the intermediate materialization bytes avoided — via
     the SAME classifier the runtime planner runs, so
-    `drift.decode_cols_fast` pins to zero."""
+    `drift.decode_cols_fast` pins to zero.
+
+    `partitions` (per-partition `{"cached": bool, "bytes": int}` records
+    from the runner's state-repository probe, partition order) switches
+    on the partition-state-cache prediction: the scan pass reports how
+    many partitions will load as cached states vs scan, and the file
+    bytes the cached ones avoid reading — pinned against the observed
+    `partitions_cached` / `partitions_scanned` trace counters."""
     from deequ_tpu.analyzers.base import Preconditions, ScanShareableAnalyzer
     from deequ_tpu.analyzers.frequency import (
         FrequencyBasedAnalyzer,
@@ -857,6 +886,21 @@ def analyze_plan(
                 read_bytes_per_row=pass_read_bytes_per_row(cols, schema),
             )
         )
+
+    # ---- partition-state cache (partitioned parquet sources) ---------------
+    # `partitions` records ({"cached": bool, "bytes": int}, partition
+    # order) come from the runner's pre-scan repository probe with the
+    # exact fingerprint + plan signature the fused pass will use, so
+    # `drift.partitions_cached` / `drift.partitions_scanned` pin to zero
+    if partitions is not None:
+        scan = cost.scan_pass
+        if scan is not None:
+            cached = [p for p in partitions if p.get("cached")]
+            scan.partitions_total = len(partitions)
+            scan.partitions_cached = len(cached)
+            scan.saved_partition_bytes = float(
+                sum(int(p.get("bytes", 0)) for p in cached)
+            )
 
     return cost
 

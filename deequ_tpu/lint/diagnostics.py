@@ -50,6 +50,7 @@ CODES = {
     "DQ311": "statistics prove every row group skippable",
     "DQ312": "column falls off the decode fast path",
     "DQ313": "column falls off decode-to-wire fusion",
+    "DQ314": "state-cache entry unusable; partition falls back to rescan",
 }
 
 

@@ -320,6 +320,13 @@ def _render_pass(p: PassCost, idx: int) -> List[str]:
         lines.append(f"  batches: {p.n_batches}"
                      + (f", first-batch wire {_fmt_bytes(p.wire_bytes_per_batch)}"
                         if p.wire_bytes_per_batch is not None else ""))
+        if p.partitions_total is not None and p.partitions_cached is not None:
+            lines.append(
+                f"  partitions: {p.partitions_cached} cached, "
+                f"{p.partitions_total - p.partitions_cached} scanned"
+                + (f" (saves ~{_fmt_bytes(p.saved_partition_bytes)} read)"
+                   if p.saved_partition_bytes else "")
+            )
         if p.rg_total is not None and p.rg_skipped is not None:
             lines.append(
                 f"  row groups: {p.rg_total - p.rg_skipped} decoded, "
@@ -478,6 +485,7 @@ def explain_plan(
     pipeline_depth: Optional[int] = None,
     row_groups: Optional[Sequence] = None,
     decode_types: Optional[Dict[str, str]] = None,
+    partitions: Optional[Sequence] = None,
 ) -> ExplainResult:
     """EXPLAIN an analysis plan against a `Table` (schema and row count
     are taken from it — still zero data scanned) or a `SchemaInfo`.
@@ -538,6 +546,7 @@ def explain_plan(
         pipeline_depth=pipeline_depth,
         row_groups=row_groups,
         decode_types=decode_types,
+        partitions=partitions,
     )
     return ExplainResult(
         cost=cost, diagnostics=cost_diagnostics(cost, plan, schema)

@@ -387,6 +387,7 @@ def validate_plan(
     streaming: bool = False,
     stream_batch_rows: Optional[int] = None,
     row_groups: Optional[Sequence] = None,
+    partitions: Optional[Sequence] = None,
 ) -> LintReport:
     """Run the full static pass: semantic lints (DQ1xx/DQ2xx) plus the
     cost analyzer's performance lints (DQ3xx, lint/explain.py). The
@@ -412,6 +413,7 @@ def validate_plan(
             streaming=streaming,
             stream_batch_rows=stream_batch_rows,
             row_groups=row_groups,
+            partitions=partitions,
         )
         report.extend(cost_diagnostics(report.plan_cost, plan, schema))
     except Exception:  # noqa: BLE001 — cost lint must never break a run

@@ -110,3 +110,17 @@ def record_wire_fused(fused: int, total: int) -> None:
     if tracer is not None:
         tracer.count("wire_fused_cols", int(fused))
         tracer.count("wire_cols_total", int(total))
+
+
+def record_state_cache(cached: int, scanned: int, total: int) -> None:
+    """Partition-split outcome of one partitioned fused scan: partitions
+    whose states loaded from the state cache vs partitions that decoded
+    and folded, out of the dataset's partition count. Tracer-only, like
+    record_pruned_groups; the counters feed cost_drift's
+    `drift.partitions_cached` pin and the `engine.state_cache_hit_ratio`
+    telemetry series."""
+    tracer = spans.current_tracer()
+    if tracer is not None:
+        tracer.count("partitions_cached", int(cached))
+        tracer.count("partitions_scanned", int(scanned))
+        tracer.count("partitions_total", int(total))

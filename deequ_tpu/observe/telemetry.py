@@ -179,6 +179,16 @@ def engine_metric_record(
             rec.get("engine.counter.wire_fused_cols", 0.0) / wire_total
         )
 
+    # derived: fraction of dataset partitions whose analyzer states
+    # loaded from the persistent state cache instead of scanning — the
+    # sentinel watches it for incremental-scan regressions; only present
+    # when a partitioned run actually split cached vs scanned
+    partitions_total = rec.get("engine.counter.partitions_total", 0.0)
+    if partitions_total > 0.0:
+        rec["engine.state_cache_hit_ratio"] = (
+            rec.get("engine.counter.partitions_cached", 0.0) / partitions_total
+        )
+
     # satellite: traced_run stamps these on the root span; live /proc read
     # covers traces produced before the attributes existed.
     res = proc_resources()

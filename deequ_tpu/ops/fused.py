@@ -531,6 +531,28 @@ class AnalyzerRunResult:
         return self.state
 
 
+def _merge_partition_results(
+    a: AnalyzerRunResult, b: AnalyzerRunResult
+) -> AnalyzerRunResult:
+    """Semigroup merge of one analyzer's outcome across two partitions:
+    errors win (a failing analyzer fails for the dataset, matching the
+    single-pass contract), a None state is the identity (an empty
+    partition contributes nothing), and a failing merge becomes that
+    analyzer's error, never the pass's."""
+    if a.error is not None:
+        return a
+    if b.error is not None:
+        return b
+    if a.state is None:
+        return AnalyzerRunResult(a.analyzer, state=b.state)
+    if b.state is None:
+        return a
+    try:
+        return AnalyzerRunResult(a.analyzer, state=a.state.merge(b.state))
+    except Exception as e:  # noqa: BLE001
+        return AnalyzerRunResult(a.analyzer, error=e)
+
+
 def _to_f64(tree: Any) -> Any:
     return jax.tree_util.tree_map(
         lambda x: np.asarray(x, dtype=np.float64), tree
@@ -1605,6 +1627,7 @@ class FusedScanPass:
         self,
         analyzers: Sequence[ScanShareableAnalyzer],
         batch_size: Optional[int] = None,
+        state_cache=None,
     ):
         self.analyzers = list(analyzers)
         # None = unset: the pass may widen the default for pure-host
@@ -1614,8 +1637,19 @@ class FusedScanPass:
         self.batch_size = (
             batch_size if batch_size is not None else DEFAULT_BATCH_SIZE
         )
+        # repository/states.StateCacheContext (or None): lets a
+        # partitioned run swap a partition's scan for a state load
+        self._state_cache = state_cache
 
     def run(self, table: Table) -> List[AnalyzerRunResult]:
+        if getattr(table, "partitions", None) is not None:
+            # partitioned dataset: fold per partition, merge states in
+            # deterministic partition order — the shape that makes the
+            # state cache a pure scan-for-load swap (bit-identical)
+            return self._run_partitioned(table)
+        return self._run_single(table)
+
+    def _run_single(self, table: Table) -> List[AnalyzerRunResult]:
         # 1. plan: member placement + deduplicated input specs via the
         #    pure planner (an analyzer whose spec construction fails —
         #    e.g. unparseable predicate — fails alone, not the pass)
@@ -1699,6 +1733,86 @@ class FusedScanPass:
                     results.setdefault(i, AnalyzerRunResult(self.analyzers[i], error=e))
 
         return [results[i] for i in range(len(self.analyzers))]
+
+    def _run_partitioned(self, source) -> List[AnalyzerRunResult]:
+        """Cached-vs-scan split over a partitioned source: for every
+        partition in deterministic order, either load its analyzer
+        states from the attached state cache (fingerprint + plan
+        signature hit) or scan just that partition through the normal
+        single-source path and publish its states; then merge partition
+        states through the `State.merge` semigroup IN PARTITION ORDER.
+        Cache on, off, or absent all fold and merge identically — only
+        where a partition's states come from differs — so results are
+        bit-identical to a full rescan by construction."""
+        parts = list(source.partitions())
+        cache = (
+            self._state_cache
+            if self._state_cache is not None and runtime.state_cache_enabled()
+            else None
+        )
+        signature = None
+        if cache is not None:
+            from deequ_tpu.repository.states import plan_signature
+
+            batch_rows = getattr(source, "batch_rows", None)
+            signature = plan_signature(
+                self.analyzers,
+                placement=runtime.placement_mode(),
+                compute_dtype=np.dtype(runtime.compute_dtype()).name,
+                batch_size=(
+                    self.batch_size if self._batch_size_explicit else None
+                ),
+                batch_rows=int(batch_rows) if batch_rows else None,
+            )
+        merged: Optional[List[AnalyzerRunResult]] = None
+        cached_n = 0
+        scanned_n = 0
+        for part in parts:
+            results: Optional[List[AnalyzerRunResult]] = None
+            if cache is not None:
+                sp = observe.span(
+                    "state_cache", cat="cache", op="load", partition=part.name
+                )
+                with sp:
+                    states = cache.repository.load_states(
+                        cache.dataset, part.fingerprint, signature,
+                        self.analyzers,
+                    )
+                    if sp:
+                        sp.set(hit=states is not None)
+                if states is not None:
+                    results = [
+                        AnalyzerRunResult(a, state=s)
+                        for a, s in zip(self.analyzers, states)
+                    ]
+                    cached_n += 1
+            if results is None:
+                sub = FusedScanPass(
+                    self.analyzers,
+                    self.batch_size if self._batch_size_explicit else None,
+                )
+                results = sub.run(part.source())
+                scanned_n += 1
+                if cache is not None and all(r.error is None for r in results):
+                    with observe.span(
+                        "state_cache", cat="cache", op="save",
+                        partition=part.name,
+                    ):
+                        cache.repository.save_states(
+                            cache.dataset, part.fingerprint, signature,
+                            [(r.analyzer, r.state) for r in results],
+                        )
+            merged = (
+                results
+                if merged is None
+                else [
+                    _merge_partition_results(m, r)
+                    for m, r in zip(merged, results)
+                ]
+            )
+        runtime.record_state_cache(cached_n, scanned_n, len(parts))
+        assert merged is not None  # constructor guarantees >= 1 partition
+        return merged
 
     def _run_pass(
         self,

@@ -246,6 +246,24 @@ def wire_fused_enabled() -> bool:
     return os.environ.get("DEEQU_TPU_WIRE_FUSED", "") not in ("0", "off")
 
 
+def state_cache_enabled() -> bool:
+    """Whether partitioned scans may consult an attached StateRepository
+    (repository/states.py): partitions whose fingerprint + plan
+    signature already have a stored state envelope load as states
+    instead of decoding and folding their rows.
+
+    `DEEQU_TPU_STATE_CACHE=0` (or `off`) is the kill switch: every
+    partition scans, exactly as with no repository attached — the
+    baseline the state-cache differential suite compares against.
+    Partitioned sources fold per partition and merge in deterministic
+    partition order either way, so results are bit-identical; only
+    whether a partition's states come from a scan or from disk
+    changes."""
+    import os
+
+    return os.environ.get("DEEQU_TPU_STATE_CACHE", "") not in ("0", "off")
+
+
 def wire_pad_size(n: int, batch_size: int) -> int:
     """The fused pass's padded row length for an n-row batch (mirror of
     ops/fused.py:_pad_size, which delegates here): power of two, min 8,
@@ -566,6 +584,10 @@ def record_decode_fastpath(fast: int, total: int, workers: int) -> None:
 
 def record_wire_fused(fused: int, total: int) -> None:
     _counters.record_wire_fused(fused, total)
+
+
+def record_state_cache(cached: int, scanned: int, total: int) -> None:
+    _counters.record_state_cache(cached, scanned, total)
 
 
 def pad_to(arr: np.ndarray, size: int) -> np.ndarray:

@@ -6,6 +6,12 @@ from deequ_tpu.repository.base import (
 )
 from deequ_tpu.repository.memory import InMemoryMetricsRepository
 from deequ_tpu.repository.fs import FileSystemMetricsRepository
+from deequ_tpu.repository.states import (
+    FileSystemStateRepository,
+    InMemoryStateRepository,
+    StateCacheContext,
+    StateRepository,
+)
 
 __all__ = [
     "AnalysisResult",
@@ -14,4 +20,8 @@ __all__ = [
     "ResultKey",
     "InMemoryMetricsRepository",
     "FileSystemMetricsRepository",
+    "FileSystemStateRepository",
+    "InMemoryStateRepository",
+    "StateCacheContext",
+    "StateRepository",
 ]

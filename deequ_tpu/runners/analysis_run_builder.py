@@ -31,6 +31,8 @@ class AnalysisRunBuilder:
         self._mesh = None
         self._validation: Optional[str] = None
         self._tracing = None
+        self._state_repository = None
+        self._dataset_name: str = "default"
 
     def with_tracing(self, trace=True) -> "AnalysisRunBuilder":
         """Run observability (deequ_tpu.observe): True records a
@@ -81,6 +83,21 @@ class AnalysisRunBuilder:
         self._save_states_with = persister
         return self
 
+    def with_state_repository(
+        self, repository, dataset: str = "default"
+    ) -> "AnalysisRunBuilder":
+        """Attach a partition-state cache (repository/states.py:
+        `StateRepository`). Over a partitioned source
+        (`Table.scan_parquet_dataset`), partitions whose fingerprint and
+        plan signature already have stored states load instead of
+        scanning, and newly scanned partitions publish their states —
+        making re-runs cost proportional to NEW data while staying
+        bit-identical to a full rescan. `dataset` namespaces the
+        entries; `DEEQU_TPU_STATE_CACHE=0` is the kill switch."""
+        self._state_repository = repository
+        self._dataset_name = dataset
+        return self
+
     def use_repository(self, repository: "MetricsRepository") -> "AnalysisRunBuilder":
         self._metrics_repository = repository
         return self
@@ -112,4 +129,6 @@ class AnalysisRunBuilder:
             mesh=self._mesh,
             validation=self._validation,
             tracing=self._tracing,
+            state_repository=self._state_repository,
+            dataset_name=self._dataset_name,
         )

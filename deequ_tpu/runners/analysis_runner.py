@@ -48,6 +48,8 @@ class AnalysisRunner:
         mesh=None,
         validation: Optional[str] = None,
         tracing=None,
+        state_repository=None,
+        dataset_name: str = "default",
     ) -> AnalyzerContext:
         if not analyzers:
             return AnalyzerContext.empty()
@@ -72,6 +74,8 @@ class AnalysisRunner:
                 engine,
                 mesh,
                 validation,
+                state_repository,
+                dataset_name,
             )
         if run:
             context.run_trace = run.trace
@@ -91,13 +95,27 @@ class AnalysisRunner:
         engine: str = "auto",
         mesh=None,
         validation: Optional[str] = None,
+        state_repository=None,
+        dataset_name: str = "default",
     ) -> AnalyzerContext:
+        # partition-state cache (repository/states.py): only partitioned
+        # sources have a per-partition fold to cache; the context rides
+        # the fused pass (the distributed/mesh path always scans)
+        state_cache = None
+        if (
+            state_repository is not None
+            and getattr(data, "partitions", None) is not None
+        ):
+            from deequ_tpu.repository.states import StateCacheContext
+
+            state_cache = StateCacheContext(state_repository, dataset_name)
+
         # plan-time static analysis (see deequ_tpu/lint): strict raises
         # before any kernel dispatch, lenient attaches diagnostics to the
         # returned context as `validation_warnings`
         with observe.span("plan_validate", cat="plan"):
             validation_diagnostics, plan_cost = AnalysisRunner._validate_plan(
-                data, analyzers, validation
+                data, analyzers, validation, state_cache
             )
 
         from deequ_tpu.runners.engine import resolve_engine
@@ -161,7 +179,8 @@ class AnalysisRunner:
 
         # 4. fused scan pass (reference: AnalysisRunner.scala:279-326)
         scanning_results = AnalysisRunner._run_scanning_analyzers(
-            data, scanning, aggregate_with, save_states_with, mesh
+            data, scanning, aggregate_with, save_states_with, mesh,
+            state_cache,
         )
 
         # 5. one frequency pass per grouping-column-set
@@ -189,7 +208,7 @@ class AnalysisRunner:
 
     # ------------------------------------------------------------------
     @staticmethod
-    def _validate_plan(data, analyzers, validation):
+    def _validate_plan(data, analyzers, validation, state_cache=None):
         """-> (diagnostics, PlanCost | None). The cost prediction rides
         the same static pass and lands on the context as `plan_cost`."""
         from deequ_tpu.lint import PlanValidationError, SchemaInfo, validate_plan
@@ -212,6 +231,16 @@ class AnalysisRunner:
                     row_groups = stats_fn()
                 except Exception:  # noqa: BLE001 — stats are advisory
                     row_groups = None
+            # partitioned sources: predict the state-cache split by
+            # probing the repository with the SAME fingerprint + plan
+            # signature the fused pass will use — so
+            # `drift.partitions_cached` pins to zero on a warm run
+            partitions = None
+            parts_fn = getattr(data, "partitions", None)
+            if parts_fn is not None:
+                partitions = AnalysisRunner._predict_partitions(
+                    data, analyzers, state_cache
+                )
             report = validate_plan(
                 schema,
                 checks=(),
@@ -221,6 +250,7 @@ class AnalysisRunner:
                 streaming=streaming,
                 stream_batch_rows=int(cap) if cap else None,
                 row_groups=row_groups,
+                partitions=partitions,
             )
             return list(report.diagnostics), report.plan_cost
         except PlanValidationError:
@@ -230,12 +260,56 @@ class AnalysisRunner:
 
     # ------------------------------------------------------------------
     @staticmethod
+    def _predict_partitions(data, analyzers, state_cache):
+        """Per-partition cache prediction records for `analyze_plan`:
+        `{"cached": bool, "bytes": int}` per partition, in partition
+        order. Mirrors the runner's own filtering (dedupe, grouping
+        split, scan-shareable only) so the probe signature matches the
+        one `FusedScanPass._run_partitioned` computes."""
+        import os
+
+        from deequ_tpu.analyzers.grouping import GroupingAnalyzer
+        from deequ_tpu.ops import runtime
+
+        probe = None
+        if state_cache is not None and runtime.state_cache_enabled():
+            from deequ_tpu.repository.states import plan_signature_for
+
+            seen: set = set()
+            shareable = []
+            for a in analyzers:
+                if a in seen:
+                    continue
+                seen.add(a)
+                if isinstance(a, ScanShareableAnalyzer) and not isinstance(
+                    a, GroupingAnalyzer
+                ):
+                    shareable.append(a)
+            probe = plan_signature_for(shareable, data)
+        records = []
+        for part in data.partitions():
+            cached = bool(
+                probe is not None
+                and state_cache.repository.has_states(
+                    state_cache.dataset, part.fingerprint, probe
+                )
+            )
+            try:
+                nbytes = int(os.path.getsize(part.path))
+            except OSError:
+                nbytes = 0
+            records.append({"cached": cached, "bytes": nbytes})
+        return records
+
+    # ------------------------------------------------------------------
+    @staticmethod
     def _run_scanning_analyzers(
         data: Table,
         analyzers: Sequence[Analyzer],
         aggregate_with: Optional["StateLoader"],
         save_states_with: Optional["StatePersister"],
         mesh=None,
+        state_cache=None,
     ) -> AnalyzerContext:
         if not analyzers:
             return AnalyzerContext.empty()
@@ -246,11 +320,16 @@ class AnalysisRunner:
         metrics: Dict[Analyzer, Metric] = {}
         if shareable:
             if mesh is not None:
+                # the distributed pass shards batches across devices —
+                # there is no per-partition fold to cache, so the mesh
+                # path always scans (documented fallback)
                 from deequ_tpu.parallel.distributed import DistributedScanPass
 
                 results = DistributedScanPass(shareable, mesh=mesh).run(data)
             else:
-                results = FusedScanPass(shareable).run(data)
+                results = FusedScanPass(
+                    shareable, state_cache=state_cache
+                ).run(data)
             for result in results:
                 analyzer = result.analyzer
                 if result.error is not None:

@@ -52,6 +52,8 @@ class VerificationSuite:
         mesh=None,
         validation: Optional[str] = None,
         tracing=None,
+        state_repository=None,
+        dataset_name: str = "default",
     ) -> VerificationResult:
         """reference: VerificationSuite.scala:107-144.
 
@@ -64,6 +66,11 @@ class VerificationSuite:
         a span tree, a str additionally names the Chrome-trace output
         path, None defers to the DEEQU_TPU_TRACE env knob, False forces
         off. The finished trace attaches as `result.run_trace`.
+
+        `state_repository` / `dataset_name` — incremental computation:
+        with a `StateRepository` and a partitioned source, unchanged
+        partitions load their folded analyzer states from the cache
+        instead of rescanning (see runners.AnalysisRunner).
         """
         with observe.traced_run(
             "verification_suite", enable=tracing, checks=len(checks)
@@ -75,7 +82,12 @@ class VerificationSuite:
             with observe.span("plan_validate", cat="plan"):
                 validation_diagnostics, plan_cost = (
                     VerificationSuite._validate_plan(
-                        data, checks, required_analyzers, validation
+                        data,
+                        checks,
+                        required_analyzers,
+                        validation,
+                        state_repository=state_repository,
+                        dataset_name=dataset_name,
                     )
                 )
 
@@ -99,6 +111,8 @@ class VerificationSuite:
                 # the suite already validated the full plan (checks included);
                 # don't lint the bare analyzer list a second time
                 validation="off",
+                state_repository=state_repository,
+                dataset_name=dataset_name,
             )
 
             verification_result = VerificationSuite.evaluate(
@@ -122,7 +136,14 @@ class VerificationSuite:
         return verification_result
 
     @staticmethod
-    def _validate_plan(data, checks, required_analyzers, validation):
+    def _validate_plan(
+        data,
+        checks,
+        required_analyzers,
+        validation,
+        state_repository=None,
+        dataset_name: str = "default",
+    ):
         """Static plan analysis before any scan -> (diagnostics,
         PlanCost | None). Strict mode propagates the aggregated
         PlanValidationError; otherwise the linter must never break a
@@ -135,12 +156,26 @@ class VerificationSuite:
             return [], None
         try:
             schema = SchemaInfo.from_table(data)
+            partitions = None
+            if getattr(data, "partitions", None) is not None:
+                analyzers: List[Analyzer] = list(required_analyzers)
+                for check in checks:
+                    analyzers.extend(check.required_analyzers())
+                cache = None
+                if state_repository is not None:
+                    from deequ_tpu.repository.states import StateCacheContext
+
+                    cache = StateCacheContext(state_repository, dataset_name)
+                partitions = AnalysisRunner._predict_partitions(
+                    data, analyzers, cache
+                )
             report = validate_plan(
                 schema,
                 checks,
                 required_analyzers,
                 mode=mode,
                 num_rows=int(data.num_rows),
+                partitions=partitions,
             )
             return list(report.diagnostics), report.plan_cost
         except PlanValidationError:

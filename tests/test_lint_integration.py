@@ -190,9 +190,9 @@ class TestSchemaInference:
 
         original = runner_mod.AnalysisRunner._validate_plan
 
-        def counting(data, analyzers, validation):
+        def counting(data, analyzers, validation, state_cache=None):
             calls.append(validation)
-            return original(data, analyzers, validation)
+            return original(data, analyzers, validation, state_cache)
 
         monkeypatch.setattr(
             runner_mod.AnalysisRunner, "_validate_plan", staticmethod(counting)

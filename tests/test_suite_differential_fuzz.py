@@ -771,3 +771,129 @@ def test_suite_layouts_agree_across_engines(layout, seed, monkeypatch):
 
     assert_snapshots_agree(host_fold, single_dev, f"{layout}:host-vs-device")
     assert_snapshots_agree(host_fold, mesh, f"{layout}:host-vs-mesh")
+
+
+# ---------------------------------------------------------------------------
+# persistent partition-state cache: incremental scans (repository/states.py)
+# ---------------------------------------------------------------------------
+
+
+def _write_partition(table, path: str) -> None:
+    table.to_parquet(
+        path,
+        row_group_size=max(64, table.num_rows // 5),
+        dictionary_encode_strings=True,
+    )
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_state_cache_on_off_bit_identical(seed, monkeypatch, tmp_path):
+    """The persistent partition-state cache is a pure scan-for-load
+    swap: with a repository attached, every run must be BIT-identical
+    to a cache-off full rescan — exact snapshot equality, sketches
+    included — through the whole dataset lifecycle (cold fill, all-hit
+    warm run, appended partition, mutated partition, renamed files that
+    reorder the partition merge) and on BOTH placements. Placement is
+    part of the plan signature, so each placement fills and hits its
+    own namespace."""
+    from deequ_tpu.data.table import Table as TableCls
+    from deequ_tpu.repository.states import FileSystemStateRepository
+
+    rng = np.random.default_rng(17_000 + seed)
+    checks = [random_check(rng) for _ in range(int(rng.integers(1, 3)))]
+    data_dir = tmp_path / "dataset"
+    data_dir.mkdir()
+    for i in range(3):
+        _write_partition(random_table(rng), str(data_dir / f"part-{i}.parquet"))
+
+    repo = FileSystemStateRepository(str(tmp_path / "cache"))
+
+    def run(placement, cached):
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+        monkeypatch.setenv("DEEQU_TPU_STATE_CACHE", "1" if cached else "0")
+        data = TableCls.scan_parquet_dataset(str(data_dir))
+        builder = VerificationSuite().on_data(data)
+        for check in checks:
+            builder = builder.add_check(check)
+        if cached:
+            builder = builder.with_state_repository(repo, "fuzz")
+        return suite_snapshot(builder.with_engine("single").run())
+
+    def check_step(step):
+        for placement in ("host", "device"):
+            baseline = run(placement, False)
+            assert run(placement, True) == baseline, (step, seed, placement)
+
+    check_step("cold")  # first cache-on run fills the repository
+    check_step("warm")  # second is all hits: merge of loaded states only
+
+    _write_partition(random_table(rng), str(data_dir / "part-3.parquet"))
+    check_step("append")  # only the new partition lacks an entry
+
+    _write_partition(random_table(rng), str(data_dir / "part-1.parquet"))
+    check_step("mutate")  # rewritten fingerprint self-invalidates
+
+    (data_dir / "part-0.parquet").rename(data_dir / "part-9.parquet")
+    check_step("reorder")  # new basename = new fingerprint AND new merge order
+
+
+def test_state_cache_drift_pins_zero_and_traces(monkeypatch, tmp_path):
+    """Warm incremental run end to end: the planner's cached/scanned
+    prediction must pin observed drift to exactly zero, the trace must
+    carry the state_cache spans and partition counters, and the engine
+    telemetry record must expose `engine.state_cache_hit_ratio == 1`."""
+    from deequ_tpu.data.table import Table as TableCls
+    from deequ_tpu.lint.cost import cost_drift
+    from deequ_tpu.observe.telemetry import engine_metric_record
+    from deequ_tpu.repository.states import FileSystemStateRepository
+
+    rng = np.random.default_rng(23)
+    data_dir = tmp_path / "dataset"
+    data_dir.mkdir()
+    for i in range(4):
+        _write_partition(random_table(rng), str(data_dir / f"p{i}.parquet"))
+    check = (
+        Check(CheckLevel.ERROR, "incremental")
+        .has_size(lambda s: s > 0)
+        .is_complete("x")
+        .has_mean("x", lambda m: True)
+        .has_standard_deviation("x", lambda s: True)
+        .has_approx_quantile("x", 0.5, lambda q: True)
+    )
+    repo = FileSystemStateRepository(str(tmp_path / "cache"))
+    monkeypatch.delenv("DEEQU_TPU_STATE_CACHE", raising=False)
+    monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "device")
+
+    def run():
+        return (
+            VerificationSuite()
+            .on_data(TableCls.scan_parquet_dataset(str(data_dir)))
+            .add_check(check)
+            .with_state_repository(repo, "drift")
+            .with_engine("single")
+            .with_tracing(True)
+            .run()
+        )
+
+    cold = run()
+    assert cold.run_trace.counters["partitions_scanned"] == 4
+    assert cold.run_trace.counters["partitions_total"] == 4
+
+    warm = run()
+    counters = warm.run_trace.counters
+    assert counters["partitions_cached"] == 4
+    assert counters["partitions_total"] == 4
+    assert "partitions_scanned" not in counters
+
+    # predicted == observed, both directions, exactly zero
+    drift = cost_drift(warm.plan_cost, warm.run_trace)
+    assert drift["drift.partitions_cached"] == 0.0
+    assert drift["drift.partitions_scanned"] == 0.0
+
+    cache_spans = [sp for sp in warm.run_trace.spans() if sp.name == "state_cache"]
+    assert len(cache_spans) == 4
+    assert all(sp.attrs.get("hit") for sp in cache_spans)
+
+    rec = engine_metric_record(warm.run_trace, warm.plan_cost)
+    assert rec["engine.state_cache_hit_ratio"] == 1.0
+    assert rec["engine.drift.partitions_cached"] == 0.0

@@ -45,6 +45,14 @@ Checks:
              path's whole point is ONE native pass from arrow buffers
              to Column backing; a host-copy idiom silently reintroduces
              the intermediate materialization it exists to remove.
+  SERDE    — no `pickle` (import or call) in the state serde paths
+             (deequ_tpu/repository/states.py,
+             deequ_tpu/analyzers/state_provider.py): persisted analyzer
+             states are exact-width binary formats that round-trip
+             bit-exactly and decode safely; pickle is neither (arbitrary
+             code execution on load, no cross-version byte stability),
+             so one import silently voids both the bit-identity and the
+             corrupt-falls-back-to-rescan contracts.
   F401*    — unused imports (fallback when ruff is unavailable).
   E722*    — bare `except:` (fallback when ruff is unavailable).
 
@@ -101,6 +109,12 @@ PUSHDOWN_FORBIDDEN_MODULES = {"pyarrow", "pandas"}
 DECODE_FILES = [
     os.path.join("deequ_tpu", "data", "arrow_decode.py"),
     os.path.join("deequ_tpu", "ops", "native", "__init__.py"),
+]
+# State serde paths: pickle is banned in any form (import, from-import,
+# attribute call) — persisted states are versioned exact-width binary.
+SERDE_FILES = [
+    os.path.join("deequ_tpu", "repository", "states.py"),
+    os.path.join("deequ_tpu", "analyzers", "state_provider.py"),
 ]
 DECODE_FORBIDDEN_ATTRS = {"to_numpy", "frombuffer"}
 # Host pack idioms banned inside the decode-to-wire fused path (any
@@ -291,6 +305,48 @@ def check_pushdown_purity(path: str) -> List[str]:
                 f"{_rel(path)}:{node.lineno}: PUSHDOWN `open(...)` in the "
                 f"stats interpreter — it must never touch files; pass "
                 f"RowGroupStats in"
+            )
+    return findings
+
+
+# -- SERDE: no pickle in the state serde paths --------------------------------
+
+
+def check_serde_pickle(path: str) -> List[str]:
+    """Flag any appearance of pickle in the state serde paths: plain or
+    from-imports (top-level or inside any function, including the
+    `cPickle`/`dill`/`cloudpickle` spellings) and `pickle.loads/dumps`
+    attribute calls. Persisted analyzer states must stay exact-width
+    versioned binary — pickle would execute arbitrary bytecode on load
+    and break byte stability across versions."""
+    serde_banned = {"pickle", "cPickle", "_pickle", "dill", "cloudpickle"}
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    findings: List[str] = []
+    for node in ast.walk(tree):
+        modules: List[str] = []
+        if isinstance(node, ast.Import):
+            modules = [alias.name for alias in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            modules = [node.module]
+        for mod in modules:
+            if mod.split(".")[0] in serde_banned:
+                findings.append(
+                    f"{_rel(path)}:{node.lineno}: SERDE `{mod}` import in "
+                    f"a state serde path — persisted states are versioned "
+                    f"exact-width binary; pickle voids the bit-identity "
+                    f"and safe-decode contracts"
+                )
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in serde_banned
+        ):
+            findings.append(
+                f"{_rel(path)}:{node.lineno}: SERDE "
+                f"`{node.func.value.id}.{node.func.attr}(...)` call in a "
+                f"state serde path — use the versioned binary envelope"
             )
     return findings
 
@@ -629,6 +685,11 @@ def main() -> int:
         path = os.path.join(REPO, rel)
         if os.path.exists(path):
             findings.extend(check_decode_copies(path))
+
+    for rel in SERDE_FILES:
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):
+            findings.extend(check_serde_pickle(path))
 
     for path in _python_files():
         rel = _rel(path)

@@ -62,6 +62,12 @@ WATCHED_SERIES: Sequence[Tuple[str, str]] = (
     # to the Column path (consumer set widened, sticky spec lost, wire
     # kernels unavailable)
     ("engine.wire_fused_ratio", "down"),
+    # state-cache effectiveness: the fraction of dataset partitions whose
+    # analyzer states loaded from the persistent partition-state cache
+    # instead of rescanning; a drop means incremental runs stopped
+    # hitting (fingerprints churning, plan signature drifting, envelope
+    # decode failures falling back to rescan)
+    ("engine.state_cache_hit_ratio", "down"),
 )
 
 #: phases whose share of wall time is watched (rises are bad: a phase
