@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint typecheck analyze sentinel test test-fast trace-demo bench-pushdown bench-decode bench-wire bench-incremental
+.PHONY: lint typecheck analyze sentinel test test-fast trace-demo bench-pushdown bench-decode bench-wire bench-incremental bench-reader clean-native
 
 lint:
 	$(PY) tools/lint.py
@@ -66,6 +66,25 @@ bench-wire:
 BENCH_INCREMENTAL_ROWS ?= 6000000
 bench-incremental:
 	JAX_PLATFORMS=cpu BENCH_MODE=incremental BENCH_ROWS=$(BENCH_INCREMENTAL_ROWS) $(PY) bench.py
+
+# native parquet reader A/B over the cold 50-column stream shape under
+# the 50ms object-store stall model: same plan with
+# DEEQU_TPU_NATIVE_READER=0 then =1, bit-identity asserted, decode-stage
+# self-seconds from traced passes plus untraced cold-IO wall times.
+# Refreshes BENCH_READER.json (methodology: BENCH.md round 12)
+BENCH_READER_ROWS ?= 4000000
+bench-reader:
+	JAX_PLATFORMS=cpu BENCH_MODE=reader BENCH_ROWS=$(BENCH_READER_ROWS) $(PY) bench.py
+
+# remove cached native builds (the hash-named .so files): any strays in
+# the package tree from older versions plus the per-user cache dir the
+# build now prefers
+clean-native:
+	rm -f deequ_tpu/ops/native/_deequ_native_*.so
+	$(PY) -c "from deequ_tpu.ops.native import per_user_cache_dir as d; \
+	import glob, os; p = d(); \
+	[os.unlink(f) for f in (glob.glob(os.path.join(p, '_deequ_native_*.so')) if p else [])]; \
+	print('clean-native:', p or '(no user cache dir)')"
 
 test: lint
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q

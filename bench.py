@@ -46,6 +46,14 @@ Knobs (env):
                     aborts unless metrics are bit-identical and exactly
                     one partition scanned. BENCH_INCR_PARTS sets the
                     partition count (default 12, min 10)
+                    reader = native parquet page->wire reader A/B
+                    (BENCH_READER.json, BENCH.md round 12): the decode
+                    bench's 50-column wide-stream scan under a 50 ms
+                    per-row-group source stall (DEEQU_TPU_SOURCE_STALL_MS)
+                    with DEEQU_TPU_NATIVE_READER=0 then =1, page cache
+                    dropped before each timed pass; decode-stage busy
+                    seconds come from traced warm passes. Refreshes
+                    BENCH_READER.json
     BENCH_TIMED     timed repetitions, best-of (default 5: shared-vCPU
                      boxes show 20-30% run-to-run noise; best-of-5 reads
                      the machine's actual capability. Compile happens
@@ -571,7 +579,11 @@ def run_pushdown_bench(n_rows: int) -> None:
 
 
 def write_decode_parquet(
-    n_rows: int, path: str, chunk: int = 2_000_000, null_frac: float = 0.03
+    n_rows: int,
+    path: str,
+    chunk: int = 2_000_000,
+    null_frac: float = 0.03,
+    row_group_size: int = 0,
 ) -> None:
     """The decode-wall shape: the 50-column wide stream mix with ~3%
     nulls in EVERY column — the reason a data-quality engine scans a
@@ -616,7 +628,7 @@ def write_decode_parquet(
         at = pa.table(data)
         if writer is None:
             writer = pq.ParquetWriter(path, at.schema)
-        writer.write_table(at)
+        writer.write_table(at, row_group_size=row_group_size or None)
         done += rows
         seed += 1
     if writer is not None:
@@ -1044,6 +1056,262 @@ def run_wire_bench(n_rows: int) -> None:
         f"(+{100.0 * (off_s - on_s) / off_s:.1f}%), decode+prep self "
         f"{combined_off:.2f}s -> {combined_on:.2f}s (-{reduction:.1f}%), "
         f"{plan['cols_wire_fused']}/{plan['cols_total']} cols fused; "
+        f"gen={gen_s:.1f}s",
+        file=sys.stderr,
+    )
+    print(json.dumps(rec))
+
+
+def reader_analyzers():
+    """The reader-bound plan for BENCH_MODE=reader: Completeness +
+    Mean over the 35 numeric/boolean columns of the 50-column wide
+    stream. Column pruning then drops the string columns from the scan
+    altogether, so every scanned column-chunk has a native page recipe
+    and the A/B isolates the page->wire reader + readahead against the
+    pyarrow read chain under the stall model. (Scanning the strings
+    too would measure the per-column arrow fallback instead — that
+    path's bit-identity is pinned by the differential fuzz tests.)"""
+    from deequ_tpu.analyzers import Completeness, Mean
+
+    names = (
+        [f"f{i:02d}" for i in range(20)]
+        + [f"i{i:02d}" for i in range(10)]
+        + [f"b{i}" for i in range(5)]
+    )
+    out = [Completeness(c) for c in names]
+    out += [Mean(f"f{i:02d}") for i in range(20)]
+    out += [Mean(f"i{i:02d}") for i in range(10)]
+    return out
+
+
+def _reader_span_stats(roots):
+    """Runtime reader tallies from a traced pass: summed `page_decode`
+    chunk verdicts + readahead hits and `page_read` bytes. The chunk
+    sum is the runtime twin of the planner's reader_chunks_native
+    counter — equal when no chunk silently fell off mid-scan."""
+    stats = {
+        "chunks_native": 0,
+        "chunks_fallback": 0,
+        "readahead_hits": 0,
+        "decode_units": 0,
+        "read_bytes": 0,
+    }
+
+    def visit(span):
+        if span.name == "page_decode":
+            stats["chunks_native"] += int(span.attrs.get("chunks_native", 0))
+            stats["chunks_fallback"] += int(
+                span.attrs.get("chunks_fallback", 0)
+            )
+            stats["readahead_hits"] += (
+                1 if span.attrs.get("readahead_hit") else 0
+            )
+            stats["decode_units"] += 1
+        elif span.name == "page_read":
+            stats["read_bytes"] += int(span.attrs.get("bytes_read", 0))
+        for child in span.children:
+            visit(child)
+
+    for root in roots:
+        visit(root)
+    return stats
+
+
+def run_reader_bench(n_rows: int) -> None:
+    """BENCH_MODE=reader: A/B the native parquet page->wire reader
+    (ISSUE 11) on the decode bench's 50-column wide-stream shape under
+    a 50 ms per-row-group source stall (the object-store latency model,
+    DEEQU_TPU_SOURCE_STALL_MS). DEEQU_TPU_NATIVE_READER=0 reads every
+    column chunk through pyarrow inside the decode workers, paying the
+    stall serially with the decompress+decode work; =1 moves the stall
+    and the preads onto the dedicated read-ahead fetch thread and
+    page-decodes the planner-approved chunks through
+    ops/native/parquet_read.c, so IO latency overlaps decode. Same
+    discipline as the decode/wire A/Bs: a traced warm-up (jit + imports
+    + the planner's reader verdict from its decode_fastpath span), one
+    traced WARM pass per side for decode-stage busy seconds and the
+    occupancy re-baseline (traced passes are never the timed ones),
+    then two warm-jit cold-IO UNTRACED timed passes. The headline is
+    the decode-STAGE busy time (pipe_item spans): the reader moves
+    work out of the stage entirely, so stage busy — not any one span's
+    self time — is what it shrinks. Aborts on any metric mismatch.
+    Refreshes BENCH_READER.json (round/config preserved)."""
+    import pyarrow.parquet as pq
+
+    from deequ_tpu import observe
+    from deequ_tpu.data.table import Table
+    from deequ_tpu.ops.fused import FusedScanPass
+
+    # own file, NOT the decode bench's: object-store parquet comes from
+    # incremental writers in many small row groups (one ranged GET
+    # each) — the layout the stall model charges for and the readahead
+    # overlaps
+    path = os.environ.get("BENCH_PARQUET", "/tmp/bench_reader.parquet")
+    rg_rows = 1 << 15
+    t_gen = time.perf_counter()
+    if not (
+        os.path.exists(path) and pq.ParquetFile(path).metadata.num_rows == n_rows
+    ):
+        write_decode_parquet(n_rows, path, row_group_size=rg_rows)
+    gen_s = time.perf_counter() - t_gen
+
+    analyzers = reader_analyzers()
+    # the latency model the readahead overlaps: one 50 ms ranged GET
+    # per row group, both sides pay it
+    stall_ms = int(os.environ.get("BENCH_READER_STALL_MS", "50"))
+    os.environ["DEEQU_TPU_SOURCE_STALL_MS"] = str(stall_ms)
+    workers_n = min(os.cpu_count() or 1, 4)
+    os.environ["DEEQU_TPU_DECODE_WORKERS"] = str(workers_n)
+
+    def run_once():
+        snapshot = {}
+        for r in FusedScanPass(analyzers).run(
+            Table.scan_parquet(path, batch_rows=1 << 20)
+        ):
+            value = r.analyzer.compute_metric_from(r.state_or_raise()).value
+            v = (
+                value.get()
+                if value.is_success
+                else type(value.exception).__name__
+            )
+            if isinstance(v, float) and v != v:
+                v = "nan"  # nan != nan would defeat the A/B comparison
+            snapshot[repr(r.analyzer)] = v
+        return snapshot
+
+    # warm-up FIRST (traced, reader ON): compiles every program, pays
+    # the one-time imports, and its decode_fastpath span carries the
+    # planner's per-chunk reader verdict
+    os.environ["DEEQU_TPU_NATIVE_READER"] = "1"
+    with observe.tracing() as tracer_warm:
+        warm_snapshot = run_once()
+    plan = {
+        "cols_total": 0,
+        "cols_fast": 0,
+        "cols_reader": 0,
+        "reader_groups": 0,
+    }
+
+    def visit(span):
+        if span.name == "decode_fastpath":
+            for key in plan:
+                plan[key] = max(plan[key], int(span.attrs.get(key, 0)))
+        for child in span.children:
+            visit(child)
+
+    for root in tracer_warm.roots:
+        visit(root)
+
+    # decode-stage busy seconds per side from one traced WARM pass each
+    # (jit and page cache hot; the stall model still fires, so the
+    # delta isolates stall overlap + native page decode)
+    os.environ["DEEQU_TPU_NATIVE_READER"] = "0"
+    with observe.tracing() as tracer_off:
+        off_traced_snapshot = run_once()
+    os.environ["DEEQU_TPU_NATIVE_READER"] = "1"
+    with observe.tracing() as tracer_on:
+        on_traced_snapshot = run_once()
+    stage_s_off = _decode_stage_busy_s(tracer_off.roots)
+    stage_s_on = _decode_stage_busy_s(tracer_on.roots)
+    occupancy_off = _occupancy_rows(tracer_off.roots)
+    occupancy_on = _occupancy_rows(tracer_on.roots)
+    runtime_stats = _reader_span_stats(tracer_on.roots)
+    counters = dict(tracer_on.counters)
+    planned_native = int(counters.get("reader_chunks_native", 0))
+    if runtime_stats["chunks_native"] != planned_native:
+        raise SystemExit(
+            "reader A/B: runtime chunk count drifted from the plan "
+            f"(planned {planned_native}, page_decode spans saw "
+            f"{runtime_stats['chunks_native']}) — a silent mid-scan "
+            "fall-off would make the on side's numbers a lie"
+        )
+
+    # warm-jit cold-IO wall times, untraced, page cache dropped
+    os.environ["DEEQU_TPU_NATIVE_READER"] = "0"
+    cache_dropped = _drop_page_cache()
+    t0 = time.perf_counter()
+    off_snapshot = run_once()
+    off_s = time.perf_counter() - t0
+
+    os.environ["DEEQU_TPU_NATIVE_READER"] = "1"
+    _drop_page_cache()
+    t0 = time.perf_counter()
+    on_snapshot = run_once()
+    on_s = time.perf_counter() - t0
+
+    if not (
+        warm_snapshot == off_traced_snapshot == on_traced_snapshot
+        == off_snapshot == on_snapshot
+    ):
+        raise SystemExit(
+            "reader A/B: metric mismatch between the native-reader and "
+            f"pyarrow sides\noff: {off_snapshot}\non:  {on_snapshot}"
+        )
+
+    reduction = (
+        100.0 * (stage_s_off - stage_s_on) / stage_s_off
+        if stage_s_off > 0
+        else 0.0
+    )
+    speedup_x = stage_s_off / stage_s_on if stage_s_on > 0 else 0.0
+    rec = {
+        "metric": "reader_rows_per_sec_per_chip",
+        "value": round(n_rows / on_s, 1),
+        "unit": "rows/s",
+        "rows": n_rows,
+        "columns": plan["cols_total"],
+        "reader_ab": {
+            "off_s": round(off_s, 2),
+            "on_s": round(on_s, 2),
+            "speedup_pct": round(100.0 * (off_s - on_s) / off_s, 1),
+            "decode_stage_s_off": round(stage_s_off, 2),
+            "decode_stage_s_on": round(stage_s_on, 2),
+            "decode_stage_reduction_pct": round(reduction, 1),
+            "decode_stage_speedup_x": round(speedup_x, 2),
+            "occupancy_off": occupancy_off,
+            "occupancy_on": occupancy_on,
+            "stall_ms": stall_ms,
+            "cols_reader": plan["cols_reader"],
+            "cols_total": plan["cols_total"],
+            "reader_groups": plan["reader_groups"],
+            "chunks_native": runtime_stats["chunks_native"],
+            "chunks_fallback": runtime_stats["chunks_fallback"],
+            "readahead_hits": runtime_stats["readahead_hits"],
+            "decode_units": runtime_stats["decode_units"],
+            "read_mb": round(runtime_stats["read_bytes"] / 1e6, 1),
+            "workers_n": workers_n,
+            "bit_identical": True,
+            "page_cache_dropped": cache_dropped,
+            "passes": (
+                "traced warm-up (on) for the reader verdict + one "
+                "traced warm pass per side for decode-stage busy "
+                "seconds and stage occupancy; both timed passes are "
+                "warm-jit, cold-IO, untraced"
+            ),
+        },
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "BENCH_READER.json")
+    try:
+        with open(out_path) as fh:
+            old = json.load(fh)
+        for key in ("round", "config"):
+            if key in old and key not in rec:
+                rec[key] = old[key]
+    except Exception:  # noqa: BLE001 - first write: no fields to carry
+        pass
+    with open(out_path, "w") as fh:
+        json.dump(rec, fh)
+        fh.write("\n")
+    print(
+        f"# bench: reader A/B off={off_s:.2f}s on={on_s:.2f}s "
+        f"(+{100.0 * (off_s - on_s) / off_s:.1f}%), decode stage "
+        f"{stage_s_off:.2f}s -> {stage_s_on:.2f}s "
+        f"({speedup_x:.2f}x, -{reduction:.1f}%), "
+        f"{runtime_stats['chunks_native']}/"
+        f"{runtime_stats['chunks_native'] + runtime_stats['chunks_fallback']}"
+        f" chunks native, {runtime_stats['readahead_hits']}/"
+        f"{runtime_stats['decode_units']} readahead hits; "
         f"gen={gen_s:.1f}s",
         file=sys.stderr,
     )
@@ -1604,6 +1872,11 @@ def main() -> None:
     if mode == "incremental":
         # self-contained A/B with its own JSON record and artifact
         run_incremental_bench(n_rows)
+        return
+
+    if mode == "reader":
+        # self-contained A/B with its own JSON record and artifact
+        run_reader_bench(n_rows)
         return
 
     t_gen = time.perf_counter()

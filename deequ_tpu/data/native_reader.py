@@ -1,0 +1,398 @@
+"""Native parquet column-chunk reader: the page->wire dispatch path.
+
+For a planner-approved column chunk (ops/fused.py:
+classify_reader_columns) this module preads the chunk's exact byte
+range, hands it to ops/native/parquet_read.c — Thrift page headers,
+snappy/zstd page bodies, PLAIN and RLE-dictionary value decode — and
+gets back Arrow-layout buffers (contiguous engine-dtype values with
+zeros at null slots, LSB validity bitmap). Assembly into the engine
+Column backing or the packed wire buffers then reuses the EXACT kernels
+the Arrow-buffer fast path uses (decode.c / wire rows), so the result
+is bit-identical to the pyarrow chain by construction.
+
+Every function returns None whenever the native route cannot take the
+input (library unavailable, page decode error, unpublished f32 shift);
+data/source.py then re-reads that column through pyarrow, bit-identical.
+
+tools/lint.py's READER rule bans pyarrow imports in this module outside
+the designated ``*_fallback`` functions — the dispatch path owns the
+bytes end to end and must never lean on pyarrow to stay honest about
+what the native reader actually covers.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deequ_tpu.data.table import Column, ColumnType, pool_empty, shared_all_true
+from deequ_tpu.ops import native, runtime
+
+__all__ = [
+    "ChunkMeta",
+    "DecodedChunk",
+    "NativeWireStub",
+    "assemble_column",
+    "assemble_wire_column",
+    "decode_chunk",
+    "fadvise_chunk",
+    "fetch_chunk",
+]
+
+
+@dataclass(frozen=True)
+class ChunkMeta:
+    """One column chunk's native-decode recipe, proved statically from
+    the parquet footer by the planner (everything here comes from
+    RowGroupStats — no page bytes were read to build it)."""
+
+    column: str
+    token: str  # engine decode token ("double", "int32", "bool", ...)
+    dtype: str  # numpy dtype name for the backing, or "bits" for bool
+    phys: int  # parquet physical type enum (native.READER_PHYS_ENUM)
+    codec: int  # parquet codec enum (native.READER_CODEC_ENUM)
+    offset: int  # chunk's first page byte (dict page when present)
+    nbytes: int  # total_compressed_size: the pread/fadvise span
+    num_values: int
+    max_def: int  # 0 = required column (no validity bitmap in pages)
+
+
+@dataclass(frozen=True)
+class DecodedChunk:
+    """One natively decoded column chunk in Arrow buffer layout:
+    `values` holds engine-dtype values (LSB bitmap for bool) with zeros
+    at null slots; `validity` is the LSB bitmap or None when null-free —
+    the same shape _validity_addr() sees on a real arrow chunk."""
+
+    token: str
+    values: np.ndarray
+    validity: Optional[np.ndarray]
+    null_count: int
+    num_values: int
+    pages: int
+    uncompressed_bytes: int
+
+
+def fadvise_chunk(fd: int, meta: ChunkMeta) -> None:
+    """Hint the kernel that `meta`'s byte range is about to be pread
+    (readahead for the NEXT row group while this one decodes).
+    Best-effort: platforms without posix_fadvise just skip it."""
+    try:
+        os.posix_fadvise(fd, meta.offset, meta.nbytes, os.POSIX_FADV_WILLNEED)
+    except (AttributeError, OSError):
+        pass
+
+
+def fetch_chunk(fd: int, meta: ChunkMeta) -> Optional[np.ndarray]:
+    """pread the chunk's exact byte range. Returns the raw bytes as a
+    uint8 array, or None on a short read (file changed under us — the
+    column falls back to pyarrow, which will raise its own error)."""
+    raw = os.pread(fd, meta.nbytes, meta.offset)
+    if len(raw) != meta.nbytes:
+        return None
+    return np.frombuffer(raw, dtype=np.uint8)
+
+
+def decode_chunk(raw: np.ndarray, meta: ChunkMeta) -> Optional[DecodedChunk]:
+    """Decode one raw chunk byte range through parquet_read.c into
+    Arrow-layout buffers. Returns None on any decode error (truncated
+    page, unexpected encoding, corrupt Thrift) — never raises for bad
+    bytes; the caller falls back to pyarrow for this column."""
+    nv = meta.num_values
+    if meta.token == "bool":
+        out_values = np.zeros((nv + 7) // 8, dtype=np.uint8)
+        itemsize = 0
+    else:
+        out_values = np.zeros(nv, dtype=np.dtype(meta.dtype))
+        itemsize = out_values.dtype.itemsize
+    out_validity = (
+        np.zeros((nv + 7) // 8, dtype=np.uint8) if meta.max_def else None
+    )
+    res = native.read_chunk(
+        raw, meta.phys, meta.codec, itemsize, meta.max_def, nv, out_values, out_validity
+    )
+    if res is None:
+        return None
+    null_count, pages, uncompressed = res
+    return DecodedChunk(
+        token=meta.token,
+        values=out_values,
+        validity=out_validity if null_count else None,
+        null_count=null_count,
+        num_values=nv,
+        pages=pages,
+        uncompressed_bytes=uncompressed,
+    )
+
+
+def _segment_overlaps(
+    segments: List[DecodedChunk], start: int, stop: int
+) -> List[Tuple[DecodedChunk, int, int]]:
+    """(segment, local_start, local_stop) triples covering [start, stop)
+    of the segments' concatenation — the batch-slice walk both assembly
+    paths share."""
+    out = []
+    base = 0
+    for seg in segments:
+        lo = max(start, base)
+        hi = min(stop, base + seg.num_values)
+        if lo < hi:
+            out.append((seg, lo - base, hi - base))
+        base += seg.num_values
+        if base >= stop:
+            break
+    return out
+
+
+def _validity_addr(seg: DecodedChunk) -> Optional[int]:
+    """Address of the segment's validity bitmap, or None when null-free
+    — mirrors arrow_decode._validity_addr on a real chunk."""
+    if seg.validity is None:
+        return None
+    return seg.validity.ctypes.data
+
+
+def assemble_column(
+    name: str,
+    token: str,
+    segments: List[DecodedChunk],
+    start: int,
+    stop: int,
+    shared: Dict[str, np.ndarray],
+) -> Optional[Column]:
+    """Rows [start, stop) of the decoded segments -> engine Column, via
+    the same decode.c kernels arrow_decode._decode_primitive feeds, at
+    the same (address, bit_offset) contract — so widening, neutral
+    fill, NaN fold, and the shared all-true mask elision are all
+    bit-identical to the Arrow-buffer fast path."""
+    if not native.available():
+        return _assemble_column_numpy_fallback(name, token, segments, start, stop)
+    n = stop - start
+    is_float = token in ("double", "float")
+    is_bool = token == "bool"
+    if is_bool:
+        out_vals = pool_empty(n, np.bool_)
+    else:
+        out_vals = pool_empty(n, np.float64 if is_float else np.int64)
+    out_valid = pool_empty(n, np.bool_)
+    invalid = 0
+    pos = 0
+    itemsize = 0 if is_bool else native.DECODE_PRIMITIVES[token][1]
+    for seg, lo, hi in _segment_overlaps(segments, start, stop):
+        m = hi - lo
+        if is_bool:
+            rc = native.decode_bool_bitmap(
+                seg.values.ctypes.data,
+                lo,
+                _validity_addr(seg),
+                lo,
+                m,
+                out_vals[pos:],
+                out_valid[pos:],
+            )
+        else:
+            rc = native.decode_primitive(
+                token,
+                seg.values.ctypes.data + lo * itemsize,
+                _validity_addr(seg),
+                lo,
+                m,
+                out_vals[pos:],
+                out_valid[pos:],
+            )
+        if rc is None:
+            return _assemble_column_numpy_fallback(name, token, segments, start, stop)
+        invalid += rc
+        pos += m
+    valid = shared_all_true(shared, n) if invalid == 0 else out_valid
+    if is_bool:
+        ctype = ColumnType.BOOLEAN
+    elif is_float:
+        # decimal logical types never reach the reader: their decode
+        # token is "decimal128(...)", not in READER_TOKENS
+        ctype = ColumnType.DOUBLE
+    else:
+        ctype = ColumnType.LONG
+    return Column(name, ctype, out_vals, valid)
+
+
+def _assemble_column_numpy_fallback(
+    name: str, token: str, segments: List[DecodedChunk], start: int, stop: int
+) -> Column:
+    """Designated fallback mirroring decode.c's semantics in numpy
+    (neutral fill 0, float NaN folds into the mask, int C-cast
+    widening). Only runs if the native library becomes unavailable
+    between chunk decode and assembly — effectively never."""
+    n = stop - start
+    is_float = token in ("double", "float")
+    is_bool = token == "bool"
+    if is_bool:
+        out_vals = np.zeros(n, dtype=np.bool_)
+    else:
+        out_vals = np.zeros(n, dtype=np.float64 if is_float else np.int64)
+    out_valid = np.zeros(n, dtype=np.bool_)
+    pos = 0
+    for seg, lo, hi in _segment_overlaps(segments, start, stop):
+        m = hi - lo
+        if seg.validity is None:
+            vmask = np.ones(m, dtype=np.bool_)
+        else:
+            vmask = np.unpackbits(seg.validity, bitorder="little")[lo:hi].astype(
+                np.bool_
+            )
+        if is_bool:
+            bits = np.unpackbits(seg.values, bitorder="little")[lo:hi]
+            out_vals[pos : pos + m] = bits.astype(np.bool_) & vmask
+        else:
+            vals = seg.values[lo:hi].astype(out_vals.dtype)
+            if is_float:
+                nan = np.isnan(vals)
+                vals = np.where(nan, 0.0, vals)
+                vmask = vmask & ~nan
+            out_vals[pos : pos + m] = np.where(vmask, vals, 0)
+        out_valid[pos : pos + m] = vmask
+        pos += m
+    ctype = (
+        ColumnType.BOOLEAN
+        if is_bool
+        else (ColumnType.DOUBLE if is_float else ColumnType.LONG)
+    )
+    return Column(name, ctype, out_vals, out_valid)
+
+
+def _wire_stub_valid_fallback(bits: np.ndarray, n: int) -> np.ndarray:
+    """Designated fallback: wire bitmask (MSB-packed) -> Column mask.
+    Same expansion arrow_decode's wire stub uses."""
+    return np.unpackbits(bits[: (n + 7) // 8], count=n).astype(np.bool_)
+
+
+class NativeWireStub(Column):
+    """Stand-in Column for a column the native reader decoded straight
+    to wire buffers. Mirrors arrow_decode.WireStubColumn, except the
+    lazy rebuild source is the retained DecodedChunk segments rather
+    than arrow chunks — an unplanned consumer still sees bit-identical
+    values/valid through assemble_column."""
+
+    def __init__(self, name, ctype, token, segments, start, stop, wire_bits):
+        self._wire_n = int(stop - start)
+        self._wire_bits = wire_bits  # None for value-only fusion
+        self._wire_token = token
+        self._wire_segments = segments
+        self._wire_start = int(start)
+        self._wire_stop = int(stop)
+        super().__init__(name, ctype, self._wire_rebuild_values, None)
+
+    def __len__(self) -> int:
+        return self._wire_n
+
+    def _wire_rebuild(self) -> Column:
+        return assemble_column(
+            self.name,
+            self._wire_token,
+            self._wire_segments,
+            self._wire_start,
+            self._wire_stop,
+            {},
+        )
+
+    def _wire_rebuild_values(self):
+        col = self._wire_rebuild()
+        if self._valid_arr is None:
+            self._valid_arr = np.asarray(col.valid)
+        return col.values
+
+    @property
+    def valid(self):
+        if self._valid_arr is None:
+            if self._wire_bits is not None:
+                self._valid_arr = _wire_stub_valid_fallback(
+                    self._wire_bits, self._wire_n
+                )
+            else:
+                self._valid_arr = np.asarray(self._wire_rebuild().valid)
+        return self._valid_arr
+
+    @valid.setter
+    def valid(self, value):
+        self._valid_arr = value
+
+
+def assemble_wire_column(
+    name: str,
+    token: str,
+    segments: List[DecodedChunk],
+    start: int,
+    stop: int,
+    spec,
+    wire,
+) -> Optional[Tuple[Column, Dict[str, "runtime.WireRow"]]]:
+    """Rows [start, stop) of the decoded segments -> packed wire
+    buffers, via the same wire_* kernels decode_wire_column feeds at the
+    same running-row-offset contract. Returns (stub, {wire_key:
+    WireRow}) or None to route the column through assemble_column this
+    batch (unpublished f32 shift, narrowed-int overflow)."""
+    if not native.available():
+        return None
+    n = stop - start
+    if n == 0:
+        return None
+    shift = 0.0
+    if spec.needs_shift:
+        resolved = wire.shift_for(f"num:{name}")
+        if resolved is None:
+            return None
+        shift = resolved
+    padded = runtime.wire_pad_size(n, wire.batch_size)
+    # np.zeros, not pool_empty: pad tail must be zero and the bitmask
+    # is OR-only (same invariants as decode_wire_column)
+    bits = np.zeros(padded // 8, dtype=np.uint8) if spec.want_valid else None
+    vals = (
+        np.zeros(padded, dtype=np.dtype(spec.value_dtype))
+        if spec.want_value
+        else None
+    )
+    is_float = token in ("double", "float")
+    invalid = 0
+    pos = 0
+    for seg, lo, hi in _segment_overlaps(segments, start, stop):
+        m = hi - lo
+        if spec.want_value or is_float:
+            itemsize = native.DECODE_PRIMITIVES[token][1]
+            rc = native.wire_primitive(
+                token,
+                seg.values.ctypes.data + lo * itemsize,
+                _validity_addr(seg),
+                lo,
+                m,
+                shift,
+                vals[pos:] if vals is not None else None,
+                bits,
+                pos,
+            )
+        else:
+            # int/bool valid-only fusion: bitmask direct from validity
+            rc = native.wire_valid_bits(_validity_addr(seg), lo, m, bits, pos)
+        if rc is None:
+            return None
+        invalid += rc
+        pos += m
+    rows: Dict[str, runtime.WireRow] = {}
+    if spec.want_value:
+        rows[f"num:{name}"] = runtime.WireRow(
+            kind=spec.value_kind, arr=vals, shift=shift
+        )
+    if spec.want_valid:
+        rows[f"valid:{name}"] = runtime.WireRow(
+            kind="bits", arr=bits, all_valid=(invalid == 0)
+        )
+    if token == "bool":
+        ctype = ColumnType.BOOLEAN
+    elif is_float:
+        ctype = ColumnType.DOUBLE
+    else:
+        ctype = ColumnType.LONG
+    stub = NativeWireStub(name, ctype, token, segments, start, stop, bits)
+    return stub, rows

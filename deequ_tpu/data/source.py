@@ -35,6 +35,12 @@ _SENTINEL = object()
 #: a single row-group decode takes longer than this)
 JOIN_TIMEOUT_S = 10.0
 
+#: ranged GETs a native-reader fetch slot keeps in flight against the
+#: DEEQU_TPU_SOURCE_STALL_MS latency model — the conventional range
+#: -request concurrency of object-store clients. Only the stall model
+#: consults this; local preads are issued back to back either way.
+READER_INFLIGHT_GETS = 8
+
 
 def _arrow_ctype(t) -> ColumnType:
     import pyarrow as pa
@@ -281,6 +287,7 @@ class ParquetSource(DataSource):
         prune_groups: Optional[Sequence[int]] = None,
         decode_fastpath: Optional[Sequence[str]] = None,
         wire_fusion=None,
+        native_reader: Optional[Sequence[str]] = None,
     ):
         import pyarrow.parquet as pq
 
@@ -303,6 +310,13 @@ class ParquetSource(DataSource):
         # skip the Column intermediate entirely. Shared by reference —
         # the plan carries the pass's sticky-shift handshake.
         self.wire_fusion = wire_fusion
+        # columns the planner proved native-reader-eligible from footer
+        # metadata (ops/fused.py:classify_reader_columns): their chunks
+        # pread + page-decode through ops/native/parquet_read.c instead
+        # of pyarrow. None/empty = the pyarrow read path everywhere.
+        self.native_reader = (
+            frozenset(native_reader) if native_reader else None
+        )
         pf = pq.ParquetFile(path)
         meta = pf.metadata
         if self.prune_groups:
@@ -342,6 +356,7 @@ class ParquetSource(DataSource):
             prune_groups=self.prune_groups,
             decode_fastpath=self.decode_fastpath,
             wire_fusion=self.wire_fusion,
+            native_reader=self.native_reader,
         )
 
     def with_prune(self, skip) -> "ParquetSource":
@@ -361,6 +376,7 @@ class ParquetSource(DataSource):
             prune_groups=skip,
             decode_fastpath=self.decode_fastpath,
             wire_fusion=self.wire_fusion,
+            native_reader=self.native_reader,
         )
 
     def with_decode_fastpath(self, names) -> "ParquetSource":
@@ -378,6 +394,7 @@ class ParquetSource(DataSource):
             prune_groups=self.prune_groups,
             decode_fastpath=names,
             wire_fusion=self.wire_fusion,
+            native_reader=self.native_reader,
         )
 
     def with_wire_fusion(self, plan) -> "ParquetSource":
@@ -394,6 +411,26 @@ class ParquetSource(DataSource):
             prune_groups=self.prune_groups,
             decode_fastpath=self.decode_fastpath,
             wire_fusion=plan,
+            native_reader=self.native_reader,
+        )
+
+    def with_native_reader(self, names) -> "ParquetSource":
+        """Native-reader view: `names` are the columns the planner proved
+        eligible for the page-level native decode (every chunk's codec,
+        encodings and nesting checked against the footer). Pure routing
+        — the native and pyarrow reads emit bit-identical buffers — so
+        this composes freely with the other with_* views."""
+        names = frozenset(names)
+        if not names or names == (self.native_reader or frozenset()):
+            return self
+        return ParquetSource(
+            self.path,
+            columns=self.columns,
+            batch_rows=self.batch_rows,
+            prune_groups=self.prune_groups,
+            decode_fastpath=self.decode_fastpath,
+            wire_fusion=self.wire_fusion,
+            native_reader=names,
         )
 
     @property
@@ -448,6 +485,7 @@ class ParquetSource(DataSource):
         pf = pq.ParquetFile(self.path)
         try:
             meta = pf.metadata
+            schema = meta.schema
             for g in range(meta.num_row_groups):
                 rg = meta.row_group(g)
                 cols = {}
@@ -456,9 +494,38 @@ class ParquetSource(DataSource):
                     name = chunk.path_in_schema
                     if name not in names:
                         continue
+                    # chunk-layout fields for the native-reader planner
+                    # (classify_reader_columns): physical type, codec,
+                    # page encodings, byte range, nesting levels. Any
+                    # read failure leaves them None — the column then
+                    # falls off the native reader, never mis-qualifies.
+                    try:
+                        se = schema.column(j)
+                        offset = int(chunk.data_page_offset)
+                        if (
+                            chunk.has_dictionary_page
+                            and chunk.dictionary_page_offset is not None
+                        ):
+                            offset = min(
+                                offset, int(chunk.dictionary_page_offset)
+                            )
+                        layout = dict(
+                            physical_type=str(chunk.physical_type),
+                            codec=str(chunk.compression),
+                            encodings=tuple(
+                                str(e) for e in chunk.encodings
+                            ),
+                            chunk_offset=offset,
+                            chunk_bytes=int(chunk.total_compressed_size),
+                            num_values=int(chunk.num_values),
+                            max_def_level=int(se.max_definition_level),
+                            max_rep_level=int(se.max_repetition_level),
+                        )
+                    except Exception:  # noqa: BLE001 - degrade to unknown
+                        layout = {}
                     st = chunk.statistics
                     if st is None:
-                        cols[name] = ColumnStats()
+                        cols[name] = ColumnStats(**layout)
                         continue
                     has_mm = bool(getattr(st, "has_min_max", False))
                     nc = (
@@ -470,6 +537,7 @@ class ParquetSource(DataSource):
                         min_value=st.min if has_mm else None,
                         max_value=st.max if has_mm else None,
                         null_count=int(nc) if nc is not None else None,
+                        **layout,
                     )
                 out.append(
                     RowGroupStats(
@@ -505,14 +573,431 @@ class ParquetSource(DataSource):
             return self.wire_fusion
         return None
 
+    def _native_reader_active(self) -> Optional[frozenset]:
+        """The planner-approved native-reader column set when every gate
+        allows it: the DEEQU_TPU_NATIVE_READER kill switch, the decode
+        fast path it assembles through (reader ⊆ fastpath by planner
+        contract), and the native library itself."""
+        from deequ_tpu.ops import native, runtime
+
+        if (
+            self.native_reader
+            and runtime.native_reader_enabled()
+            and runtime.decode_fastpath_enabled()
+            and native.available()
+        ):
+            return self.native_reader
+        return None
+
+    def _reader_chunk_meta(self, native_cols):
+        """Per-(row-group, column) native decode recipes from the footer,
+        re-proving each chunk's eligibility against what is actually on
+        disk (physical type, codec loadability, page encodings, nesting,
+        value counts). A chunk the planner approved but the footer now
+        disqualifies simply gets no recipe — it reads through pyarrow,
+        bit-identical. Never returns a recipe it cannot honor."""
+        import pyarrow.parquet as pq
+
+        from deequ_tpu.data import native_reader as nr
+        from deequ_tpu.ops import native
+
+        codec_mask = native.reader_codecs()
+        metas = {}
+        pf = pq.ParquetFile(self.path)
+        try:
+            meta = pf.metadata
+            schema = meta.schema
+            arrow_schema = pf.schema_arrow
+            tokens = {}
+            for name in native_cols:
+                try:
+                    tok = str(arrow_schema.field(name).type)
+                except KeyError:
+                    continue
+                if tok in native.READER_TOKENS:
+                    tokens[name] = tok
+            for g in range(meta.num_row_groups):
+                if self.prune_groups is not None and g in self.prune_groups:
+                    continue
+                rg = meta.row_group(g)
+                for j in range(rg.num_columns):
+                    chunk = rg.column(j)
+                    name = chunk.path_in_schema
+                    tok = tokens.get(name)
+                    if tok is None:
+                        continue
+                    se = schema.column(j)
+                    allowed_phys, dtype = native.READER_TOKENS[tok]
+                    phys = str(chunk.physical_type)
+                    codec = str(chunk.compression)
+                    encodings = {str(e) for e in chunk.encodings}
+                    if (
+                        phys not in allowed_phys
+                        or codec not in native.READER_CODEC_ENUM
+                        or not (
+                            codec_mask & native.READER_CODEC_MASK[codec]
+                        )
+                        or not encodings <= native.READER_ENCODINGS
+                        or se.max_repetition_level != 0
+                        or se.max_definition_level > 1
+                        or int(chunk.num_values) != int(rg.num_rows)
+                    ):
+                        continue
+                    offset = int(chunk.data_page_offset)
+                    if (
+                        chunk.has_dictionary_page
+                        and chunk.dictionary_page_offset is not None
+                    ):
+                        offset = min(
+                            offset, int(chunk.dictionary_page_offset)
+                        )
+                    metas[(g, name)] = nr.ChunkMeta(
+                        column=name,
+                        token=tok,
+                        dtype=dtype,
+                        phys=native.READER_PHYS_ENUM[phys],
+                        codec=native.READER_CODEC_ENUM[codec],
+                        offset=offset,
+                        nbytes=int(chunk.total_compressed_size),
+                        num_values=int(chunk.num_values),
+                        max_def=int(se.max_definition_level),
+                    )
+        finally:
+            pf.close()
+        return metas
+
     def _iter_tables(self, batch_size: int) -> Iterator[Table]:
         from deequ_tpu.ops import runtime
 
         workers = runtime.decode_workers()
-        if workers > 1:
+        if self._native_reader_active():
+            yield from self._iter_tables_native(batch_size, workers)
+        elif workers > 1:
             yield from self._iter_tables_parallel(batch_size, workers)
         else:
             yield from self._iter_tables_serial(batch_size)
+
+    def _iter_tables_native(
+        self, batch_size: int, workers: int
+    ) -> Iterator[Table]:
+        """The native parquet read path: a dedicated read-ahead thread
+        preads each unit's planner-approved column-chunk byte ranges
+        (posix_fadvise(WILLNEED) hints the NEXT unit before this one's
+        preads, so the object-store stall model overlaps IO with
+        decompression), and the decode pool page-decodes them through
+        ops/native/parquet_read.c + data/native_reader.py — pyarrow
+        reads only the columns without a native recipe. Units, batch
+        slicing and the ordered merge are IDENTICAL to
+        _iter_tables_parallel, so the batch sequence is bit-identical
+        to the pyarrow path at any worker count."""
+        import collections
+        from concurrent.futures import Future, ThreadPoolExecutor
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from deequ_tpu.data import native_reader as nr
+        from deequ_tpu.observe import heartbeat
+        from deequ_tpu.ops import runtime
+
+        fastpath = self._decode_fastpath_set()
+        wire = self._wire_fusion_active()
+        size = min(batch_size, self.batch_rows)
+        units = self._plan_decode_units(size)
+        if not units:
+            return
+        native_cols = self._native_reader_active()
+        metas = self._reader_chunk_meta(native_cols)
+        if not metas:
+            # nothing on disk qualified (footer changed since planning):
+            # take the ordinary path wholesale rather than paying the
+            # fetch-thread machinery for zero native chunks
+            if workers > 1:
+                yield from self._iter_tables_parallel(batch_size, workers)
+            else:
+                yield from self._iter_tables_serial(batch_size)
+            return
+        tokens = {m.column: m.token for m in metas.values()}
+        scanned = [n for n, _ in self._schema_cache]
+        stall_s = runtime.source_stall_s()
+        str_cols = [
+            n for n, t in self._schema_cache if t == ColumnType.STRING
+        ]
+        tracer = _spans.current_tracer()
+        parent = _spans.current_span()
+        # per-unit fetch plan: the (group, recipe) pairs the read-ahead
+        # thread preads, in deterministic (group, schema) order
+        unit_chunks = [
+            [
+                (g, metas[(g, n)])
+                for g in unit
+                for n in scanned
+                if (g, n) in metas
+            ]
+            for unit in units
+        ]
+        futures: List[Future] = [Future() for _ in units]
+        stop = threading.Event()
+        # Read-ahead window: fetch slot i may start once fewer than
+        # workers + 2 units separate it from the decode cursor. This is
+        # admission by UNIT INDEX, not a counting semaphore, because a
+        # semaphore can be barged: a slot starting unit i+3 can steal
+        # the permit a sleeping slot i was woken for, and once the
+        # window fills with units AHEAD of the decode cursor the scan
+        # deadlocks (decode waits for unit i, unit i waits for decode).
+        # The index test cannot starve: decode waiting on unit i means
+        # every unit before i is consumed, so i always clears the gate.
+        window = threading.Condition()
+        consumed = [0]
+
+        def window_wait(i: int) -> None:
+            with window:
+                while (
+                    i >= consumed[0] + workers + 2
+                    and not stop.is_set()
+                ):
+                    window.wait(1.0)
+
+        def window_advance() -> None:
+            with window:
+                consumed[0] += 1
+                window.notify_all()
+        # Readahead depth: real object stores serve overlapping range
+        # requests, so the latency model is paid per in-flight GET, not
+        # summed serially across the scan. Depth stays small — enough
+        # to hide one unit's GET behind another's decode without
+        # flooding the page cache; the window gate still bounds
+        # fetched-but-undecoded units at workers + 2.
+        fetch_depth = min(len(units), max(2, workers))
+        try:
+            read_fd = os.open(self.path, os.O_RDONLY)
+        except OSError:
+            if workers > 1:
+                yield from self._iter_tables_parallel(batch_size, workers)
+            else:
+                yield from self._iter_tables_serial(batch_size)
+            return
+
+        def fetch_unit(i: int) -> None:
+            try:
+                if stop.is_set():
+                    return
+                window_wait(i)
+                if stop.is_set():
+                    return
+                chunks = unit_chunks[i]
+                with _spans.attached(tracer, parent):
+                    # hint this unit's ranges up front: the kernel
+                    # fills the page cache during the very stall the
+                    # latency model charges below
+                    for _, m in chunks:
+                        nr.fadvise_chunk(read_fd, m)
+                    raw = {}
+                    bytes_read = 0
+                    sp = _spans.span("page_read", cat="read")
+                    with sp, heartbeat.current().timed("read"):
+                        # the object-store latency model: one ranged
+                        # GET per row group. Owning the byte schedule
+                        # means the GETs fly concurrently (capped like
+                        # any real range-request client), so the slot
+                        # pays one round of latency per in-flight
+                        # window — the serial per-group stall is
+                        # exactly what the blocking pyarrow read pays
+                        if stall_s > 0.0:
+                            rounds = -(-len(units[i]) // READER_INFLIGHT_GETS)
+                            time.sleep(stall_s * rounds)
+                        for g, m in chunks:
+                            data = nr.fetch_chunk(read_fd, m)
+                            if data is not None:
+                                bytes_read += len(data)
+                            raw[(g, m.column)] = data
+                        if sp:
+                            sp.set(
+                                groups=len(units[i]),
+                                chunks=len(chunks),
+                                bytes_read=bytes_read,
+                            )
+                futures[i].set_result(raw)
+            except BaseException:  # noqa: BLE001 - degrade to pyarrow
+                pass
+            finally:
+                if not futures[i].done():
+                    futures[i].set_result(None)
+
+        local = threading.local()
+        open_files: List = []
+        files_lock = threading.Lock()
+
+        def _pf():
+            pf = getattr(local, "pf", None)
+            if pf is None:
+                pf = pq.ParquetFile(
+                    self.path, read_dictionary=str_cols or None
+                )
+                local.pf = pf
+                with files_lock:
+                    open_files.append(pf)
+            return pf
+
+        wire_cols = set(wire.columns) if wire is not None else set()
+
+        def decode_unit(i: int) -> List[Table]:
+            unit = units[i]
+            readahead_hit = futures[i].done()
+            raw = futures[i].result()
+            window_advance()
+            with _spans.attached(tracer, parent):
+                with _spans.span(
+                    "page_decode", cat="decode", groups=len(unit)
+                ) as sp:
+                    segments: dict = {}
+                    failed: set = set()
+                    if raw is not None:
+                        for g, m in unit_chunks[i]:
+                            data = raw.get((g, m.column))
+                            dec = (
+                                nr.decode_chunk(data, m)
+                                if data is not None
+                                else None
+                            )
+                            if dec is None:
+                                failed.add(m.column)
+                            else:
+                                segments.setdefault(m.column, []).append(
+                                    dec
+                                )
+                    # a column is native for this unit only when EVERY
+                    # group chunk decoded: partial columns cannot
+                    # assemble, so they fall back whole
+                    covered = {
+                        n
+                        for n, segs in segments.items()
+                        if n not in failed and len(segs) == len(unit)
+                    }
+                    fb_cols = [n for n in scanned if n not in covered]
+                    fb_merged = None
+                    if fb_cols:
+                        pf = _pf()
+                        parts = [
+                            pf.read_row_group(g, columns=fb_cols)
+                            for g in unit
+                        ]
+                        fb_merged = (
+                            parts[0]
+                            if len(parts) == 1
+                            else pa.concat_tables(parts)
+                        )
+                        del parts
+                        total = int(fb_merged.num_rows)
+                    else:
+                        first = next(iter(covered))
+                        total = sum(
+                            seg.num_values for seg in segments[first]
+                        )
+                    tables = []
+                    for start in range(0, total, size):
+                        stop_row = min(start + size, total)
+                        fb_table = (
+                            _decode_table(
+                                fb_merged.slice(start, size),
+                                fastpath,
+                                wire,
+                            )
+                            if fb_merged is not None
+                            else None
+                        )
+                        shared: dict = {}
+                        wire_rows = dict(
+                            getattr(fb_table, "wire_rows", None) or {}
+                        )
+                        cols = []
+                        for name in scanned:
+                            if name not in covered:
+                                cols.append(fb_table.column(name))
+                                continue
+                            col = None
+                            if name in wire_cols:
+                                res = nr.assemble_wire_column(
+                                    name,
+                                    tokens[name],
+                                    segments[name],
+                                    start,
+                                    stop_row,
+                                    wire.columns[name],
+                                    wire,
+                                )
+                                if res is not None:
+                                    col, rows = res
+                                    wire_rows.update(rows)
+                            if col is None:
+                                col = nr.assemble_column(
+                                    name,
+                                    tokens[name],
+                                    segments[name],
+                                    start,
+                                    stop_row,
+                                    shared,
+                                )
+                            cols.append(col)
+                        table = Table(cols)
+                        if wire_rows:
+                            table.wire_rows = wire_rows
+                        tables.append(table)
+                    if sp:
+                        chunks_native = len(unit) * len(covered)
+                        sp.set(
+                            rows=int(total),
+                            chunks_native=chunks_native,
+                            chunks_fallback=len(unit) * len(scanned)
+                            - chunks_native,
+                            readahead_hit=bool(readahead_hit),
+                        )
+                    return tables
+
+        fetch_pool = ThreadPoolExecutor(
+            max_workers=fetch_depth, thread_name_prefix="deequ-read-ahead"
+        )
+        for i in range(len(units)):
+            fetch_pool.submit(fetch_unit, i)
+        pool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="deequ-decode-worker"
+        )
+        pending = collections.deque()
+        next_unit = 0
+        try:
+            while next_unit < len(units) or pending:
+                while next_unit < len(units) and len(pending) < workers + 1:
+                    pending.append(pool.submit(decode_unit, next_unit))
+                    next_unit += 1
+                fut = pending.popleft()
+                for table in fut.result():
+                    yield table
+        finally:
+            stop.set()
+            with window:
+                window.notify_all()
+            fetch_pool.shutdown(wait=False, cancel_futures=True)
+            for fut in futures:
+                if not fut.done():
+                    try:
+                        fut.set_result(None)
+                    except Exception:  # noqa: BLE001 - racing fetch slot
+                        pass
+            for fut in pending:
+                fut.cancel()
+            pool.shutdown(wait=True)
+            # no fetch slot may outlive the fd it preads from
+            fetch_pool.shutdown(wait=True)
+            try:
+                os.close(read_fd)
+            except OSError:
+                pass
+            with files_lock:
+                for pf in open_files:
+                    try:
+                        pf.close()
+                    except Exception:  # noqa: BLE001 - teardown best-effort
+                        pass
 
     def _iter_tables_serial(self, batch_size: int) -> Iterator[Table]:
         import pyarrow.parquet as pq

@@ -122,6 +122,18 @@ class PassCost:
     wire_fused_cols: Optional[int] = None
     wire_falloffs: Tuple[Tuple[str, str, str], ...] = ()
     saved_pack_bytes: Optional[float] = None
+    #: native-parquet-reader prediction (layered on the fast-path
+    #: verdict, needs footer chunk metadata in `row_groups`): column
+    #: chunks the page-level native reader will decode / chunks the scan
+    #: touches (scanned columns × non-pruned groups) / per-column
+    #: fall-off reasons naming the disqualifying encoding or codec /
+    #: bytes of arrow materialization the native chunks avoid over the
+    #: decoded rows. None = reader planning will not run (knob off, no
+    #: chunk metadata, no loadable codec).
+    reader_chunks_total: Optional[int] = None
+    reader_chunks_native: Optional[int] = None
+    reader_fallbacks: Tuple[Tuple[str, str], ...] = ()
+    saved_alloc_bytes: Optional[float] = None
     #: partition-state-cache prediction (partitioned parquet sources
     #: only): partitions in the dataset / partitions whose states will
     #: load from the attached StateRepository instead of scanning / file
@@ -308,6 +320,14 @@ def cost_drift(cost: "PlanCost", trace: Any) -> Dict[str, float]:
             out["drift.wire_fused_cols"] = float(
                 int(trace.counters.get("wire_fused_cols", 0))
                 - scan.wire_fused_cols
+            )
+        if (
+            scan.reader_chunks_native is not None
+            and "reader_chunks_total" in trace.counters
+        ):
+            out["drift.reader_chunks_native"] = float(
+                int(trace.counters.get("reader_chunks_native", 0))
+                - scan.reader_chunks_native
             )
         if (
             scan.partitions_cached is not None
@@ -737,6 +757,51 @@ def analyze_plan(
                             if decoded_rows is not None
                             else None
                         )
+                    # ---- native-reader verdict (layered on the fast
+                    # set; needs the footer chunk metadata carried by
+                    # row_groups). Mirrors plan_decode_fastpath's
+                    # reader branch: same knob, same classifier, same
+                    # codec mask, same prune replay — so the prediction
+                    # pins to the observed reader_chunks_native counter
+                    # with zero drift.
+                    if runtime.native_reader_enabled() and row_groups:
+                        from deequ_tpu.ops.fused import (
+                            classify_reader_columns,
+                            reader_saved_alloc_bytes_per_row,
+                        )
+
+                        codec_mask = native.reader_codecs()
+                        if codec_mask:
+                            skip = (
+                                prune_plan.skip
+                                if prune_plan is not None and pushdown_on
+                                else frozenset()
+                            )
+                            r_cols, r_falloffs, r_groups = (
+                                classify_reader_columns(
+                                    {c: col_types[c] for c in fast},
+                                    row_groups,
+                                    codec_mask,
+                                    skip,
+                                )
+                            )
+                            scan_pass.reader_chunks_native = (
+                                len(r_cols) * r_groups
+                            )
+                            scan_pass.reader_chunks_total = (
+                                dplan.total * r_groups
+                            )
+                            scan_pass.reader_fallbacks = tuple(r_falloffs)
+                            scan_pass.saved_alloc_bytes = (
+                                float(
+                                    reader_saved_alloc_bytes_per_row(
+                                        r_cols, col_types
+                                    )
+                                    * decoded_rows
+                                )
+                                if decoded_rows is not None
+                                else None
+                            )
         cost.passes.append(scan_pass)
 
         if streaming:

@@ -51,6 +51,7 @@ CODES = {
     "DQ312": "column falls off the decode fast path",
     "DQ313": "column falls off decode-to-wire fusion",
     "DQ314": "state-cache entry unusable; partition falls back to rescan",
+    "DQ315": "column-chunk falls off the native parquet reader",
 }
 
 

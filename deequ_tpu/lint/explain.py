@@ -287,6 +287,25 @@ def cost_diagnostics(
                     span=(0, len(key)) if key else None,
                 )
             )
+
+    # DQ315 — native parquet reader: fast-path columns whose column-
+    # chunks still decode through arrow because a page encoding, codec,
+    # or physical layout has no native decoder. The reason names the
+    # disqualifying property, so the fix — re-encode the file with
+    # PLAIN/RLE-dictionary pages and snappy/zstd, or flatten the nested
+    # column — is actionable per column.
+    if scan is not None and scan.reader_fallbacks:
+        for col, reason in scan.reader_fallbacks:
+            diags.append(
+                Diagnostic(
+                    "DQ315",
+                    Severity.WARNING,
+                    f"column {col!r} falls off the native parquet reader "
+                    f"({reason}): its pages decompress and decode through "
+                    "arrow instead of the page-to-wire path",
+                    source=col,
+                )
+            )
     return diags
 
 
@@ -354,6 +373,19 @@ def _render_pass(p: PassCost, idx: int) -> List[str]:
             )
             if p.saved_pack_bytes:
                 line += f" (skips ~{_fmt_bytes(p.saved_pack_bytes)} pack)"
+            lines.append(line)
+        if p.reader_chunks_total is not None and p.reader_chunks_native is not None:
+            line = (
+                f"  reader: {p.reader_chunks_native}/{p.reader_chunks_total} "
+                "column-chunks native"
+            )
+            if p.decode_workers is not None:
+                line += f", {p.decode_workers} worker(s)"
+            if p.saved_alloc_bytes:
+                line += (
+                    f" (avoids ~{_fmt_bytes(p.saved_alloc_bytes)} "
+                    "arrow materialization)"
+                )
             lines.append(line)
         for g in p.family_groups:
             tag = "batched" if g.batched else "solo"

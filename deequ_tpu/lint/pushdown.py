@@ -84,11 +84,25 @@ _CMP_OPS = ("eq", "ne", "lt", "le", "gt", "ge")
 class ColumnStats:
     """Raw per-column-chunk statistics. None = the writer did not record
     the stat (or recorded it unusably); absence degrades verdicts to
-    unknown, never to wrong."""
+    unknown, never to wrong.
+
+    The reader-eligibility fields (physical_type onward) carry the
+    footer metadata the native parquet reader's planner verdict keys
+    off (ops/fused.py:classify_reader_columns); they default to None so
+    pruning-only callers construct stats exactly as before, and absence
+    disqualifies a chunk from the native path, never mis-qualifies it."""
 
     min_value: Optional[object] = None
     max_value: Optional[object] = None
     null_count: Optional[int] = None
+    physical_type: Optional[str] = None
+    codec: Optional[str] = None
+    encodings: Optional[Tuple[str, ...]] = None
+    chunk_offset: Optional[int] = None
+    chunk_bytes: Optional[int] = None
+    num_values: Optional[int] = None
+    max_def_level: Optional[int] = None
+    max_rep_level: Optional[int] = None
 
 
 @dataclass(frozen=True)

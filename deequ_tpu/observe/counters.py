@@ -112,6 +112,20 @@ def record_wire_fused(fused: int, total: int) -> None:
         tracer.count("wire_cols_total", int(total))
 
 
+def record_reader_chunks(native: int, fallback: int, total: int) -> None:
+    """Native-reader plan outcome of one fused scan: column chunks the
+    native parquet reader decodes vs chunks that fall back to pyarrow,
+    out of the chunks the scan touches (scanned columns × non-pruned row
+    groups). Tracer-only, like record_decode_fastpath; the counters feed
+    cost_drift's `drift.reader_chunks_native` pin and the
+    `engine.reader_native_ratio` telemetry series."""
+    tracer = spans.current_tracer()
+    if tracer is not None:
+        tracer.count("reader_chunks_native", int(native))
+        tracer.count("reader_chunks_fallback", int(fallback))
+        tracer.count("reader_chunks_total", int(total))
+
+
 def record_state_cache(cached: int, scanned: int, total: int) -> None:
     """Partition-split outcome of one partitioned fused scan: partitions
     whose states loaded from the state cache vs partitions that decoded

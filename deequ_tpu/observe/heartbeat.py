@@ -39,6 +39,7 @@ __all__ = [
     "ENV_OUT",
     "NOOP_PROGRESS",
     "ScanProgress",
+    "current",
     "env_interval_s",
     "register_callback",
     "scan_heartbeat",
@@ -121,6 +122,38 @@ class _NoopProgress:
 
 
 NOOP_PROGRESS = _NoopProgress()
+
+
+# ---------------------------------------------------------------------------
+# active-progress registry
+# ---------------------------------------------------------------------------
+#
+# Worker threads the scan spawns (decode pool, the native reader's
+# read-ahead fetch thread) have no handle on the scan's progress object;
+# the registry lets them self-time under their stage without any
+# plumbing: `heartbeat.current().timed("read")`. Process-wide, not
+# thread-local, because those threads are precisely NOT the scan thread.
+
+_active_lock = threading.Lock()
+_active: List["ScanProgress"] = []
+
+
+def current() -> Any:
+    """The innermost live ScanProgress, or NOOP_PROGRESS when no
+    heartbeat is running (the usual case — everything stays no-op)."""
+    with _active_lock:
+        return _active[-1] if _active else NOOP_PROGRESS
+
+
+def _register(progress: "ScanProgress") -> None:
+    with _active_lock:
+        _active.append(progress)
+
+
+def _unregister(progress: "ScanProgress") -> None:
+    with _active_lock:
+        if progress in _active:
+            _active.remove(progress)
 
 
 # ---------------------------------------------------------------------------
@@ -277,6 +310,7 @@ class ScanProgress:
 
     def finish(self) -> None:
         """Stop the timer and emit one final (done=True) snapshot."""
+        _unregister(self)
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
@@ -317,6 +351,7 @@ def start(
         name=name,
     )
     progress.start_timer()
+    _register(progress)
     return progress
 
 

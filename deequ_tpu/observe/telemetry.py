@@ -179,6 +179,16 @@ def engine_metric_record(
             rec.get("engine.counter.wire_fused_cols", 0.0) / wire_total
         )
 
+    # derived: fraction of fast-path column-chunks the native parquet
+    # page reader decoded (page bytes straight to arrow layout, no
+    # pyarrow materialization) — the sentinel watches it for reader
+    # fall-off regressions; only present when a reader verdict ran
+    reader_total = rec.get("engine.counter.reader_chunks_total", 0.0)
+    if reader_total > 0.0:
+        rec["engine.reader_native_ratio"] = (
+            rec.get("engine.counter.reader_chunks_native", 0.0) / reader_total
+        )
+
     # derived: fraction of dataset partitions whose analyzer states
     # loaded from the persistent state cache instead of scanning — the
     # sentinel watches it for incremental-scan regressions; only present

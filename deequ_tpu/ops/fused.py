@@ -658,6 +658,17 @@ class DecodePlan:
     wire_falloffs: Tuple[Tuple[str, str, str], ...] = ()  # (col, reason, key)
     wire_batch: int = 0
     wire_specs: Any = field(default=None, compare=False)  # col -> ColumnWireSpec
+    # native-parquet-reader verdict layered on the fast set: columns
+    # whose EVERY live column chunk the page decoder proves from footer
+    # metadata (classify_reader_columns), the per-column fall-off
+    # reasons (EXPLAIN's DQ315), and the non-pruned group count the
+    # chunk counters scale by. reader_planned distinguishes "reader
+    # planning ran and fused nothing" from "never planned" so the
+    # drift pin sees 0 == 0 rather than a missing series.
+    reader_cols: Tuple[str, ...] = ()
+    reader_falloffs: Tuple[Tuple[str, str], ...] = ()  # (column, reason)
+    reader_groups: int = 0
+    reader_planned: bool = False
 
     @property
     def total(self) -> int:
@@ -713,6 +724,111 @@ def decode_saved_bytes_per_row(plan: DecodePlan, col_types: Dict[str, str]) -> i
     columns skip (value copy + mask byte-expansion)."""
     return sum(
         _DECODE_TOKEN_BYTES.get(col_types.get(c, ""), 0) + 1 for c in plan.fast
+    )
+
+
+def classify_reader_columns(
+    col_types: Dict[str, str],
+    groups,
+    codec_mask: int,
+    skip_groups=frozenset(),
+) -> Tuple[List[str], List[Tuple[str, str]], int]:
+    """Pure native-parquet-reader eligibility split over a scan's
+    fast-decode columns, proved statically from footer metadata alone.
+
+    `col_types` maps the CANDIDATE columns (the decode plan's fast set —
+    reader ⊆ fastpath by construction) to their decode tokens; `groups`
+    are the source's row_group_stats() with chunk-layout fields
+    (physical type, codec, page encodings, byte ranges, nesting);
+    `codec_mask` is native.reader_codecs()'s loadable-decompressor
+    bitmask; `skip_groups` replays the prune verdict so only chunks the
+    scan will actually read are judged. A column qualifies only when
+    EVERY live chunk does — one odd chunk falls the whole column back,
+    with a reason naming the disqualifying encoding/codec (EXPLAIN's
+    DQ315). Returns (reader_cols, falloffs, live_group_count). Shared
+    verbatim by the planner and the cost model so prediction and
+    execution can never disagree."""
+    from deequ_tpu.ops import native
+
+    live = [rg for rg in groups if rg.index not in skip_groups]
+    reader: List[str] = []
+    falloffs: List[Tuple[str, str]] = []
+    if not live:
+        return (
+            reader,
+            [(n, "every row group is pruned") for n in sorted(col_types)],
+            0,
+        )
+    for name in sorted(col_types):
+        token = col_types[name]
+        spec = native.READER_TOKENS.get(token)
+        if spec is None:
+            falloffs.append((name, f"no native page decoder for {token}"))
+            continue
+        allowed_phys, _ = spec
+        reason = None
+        for rg in live:
+            st = rg.columns.get(name)
+            if (
+                st is None
+                or st.physical_type is None
+                or st.codec is None
+                or st.encodings is None
+                or st.chunk_offset is None
+                or st.chunk_bytes is None
+                or st.num_values is None
+                or st.max_def_level is None
+                or st.max_rep_level is None
+            ):
+                reason = (
+                    f"row group {rg.index} carries no chunk layout metadata"
+                )
+                break
+            if st.physical_type not in allowed_phys:
+                reason = (
+                    f"physical type {st.physical_type} cannot back {token}"
+                )
+                break
+            bit = native.READER_CODEC_MASK.get(st.codec)
+            if bit is None:
+                reason = f"codec {st.codec} has no native decompressor"
+                break
+            if not (codec_mask & bit):
+                reason = f"codec {st.codec} library is not loadable here"
+                break
+            extra = sorted(set(st.encodings) - native.READER_ENCODINGS)
+            if extra:
+                reason = f"page encoding {extra[0]} has no native decoder"
+                break
+            if token == "bool" and (
+                set(st.encodings) & {"PLAIN_DICTIONARY", "RLE_DICTIONARY"}
+            ):
+                reason = "dictionary-encoded boolean pages decode via arrow"
+                break
+            if st.max_rep_level != 0 or st.max_def_level > 1:
+                reason = "nested or repeated values need the arrow reader"
+                break
+            if int(st.num_values) != int(rg.num_rows):
+                reason = "chunk value count disagrees with the row group"
+                break
+        if reason is not None:
+            falloffs.append((name, reason))
+        else:
+            reader.append(name)
+    return reader, falloffs, len(live)
+
+
+def reader_saved_alloc_bytes_per_row(
+    reader_cols, col_types: Dict[str, str]
+) -> int:
+    """Predicted bytes/row of arrow materialization the native reader
+    skips per fused column: the decoded arrow array (element width) plus
+    its validity bitmap byte — the buffers pyarrow would have built just
+    for the decode kernels to re-read. Prediction-only accounting for
+    EXPLAIN/cost — never used for correctness."""
+    return sum(
+        _DECODE_TOKEN_BYTES.get(col_types.get(c, ""), 0) + 1
+        for c in reader_cols
     )
 
 
@@ -983,6 +1099,40 @@ def plan_decode_fastpath(
                 dtype_name,
                 int_bounds=wire_int_bounds(table, sorted(fast_types)),
             )
+        reader_cols: Tuple[str, ...] = ()
+        reader_falloffs: Tuple[Tuple[str, str], ...] = ()
+        reader_groups = 0
+        reader_planned = False
+        if (
+            runtime.native_reader_enabled()
+            and getattr(table, "with_native_reader", None) is not None
+            and getattr(table, "row_group_stats", None) is not None
+        ):
+            # reader planning is best-effort on top of the fast-path
+            # verdict: a stats failure here must not cost the fast set
+            try:
+                codec_mask = native.reader_codecs()
+                groups = table.row_group_stats()
+                if groups and codec_mask:
+                    skip = (
+                        getattr(table, "prune_groups", None) or frozenset()
+                    )
+                    r_cols, r_falloffs, reader_groups = (
+                        classify_reader_columns(
+                            {c: col_types[c] for c in fast},
+                            groups,
+                            codec_mask,
+                            skip,
+                        )
+                    )
+                    reader_cols = tuple(r_cols)
+                    reader_falloffs = tuple(r_falloffs)
+                    reader_planned = True
+            except Exception:  # noqa: BLE001
+                reader_cols = ()
+                reader_falloffs = ()
+                reader_groups = 0
+                reader_planned = False
         return DecodePlan(
             fast=tuple(fast),
             fallbacks=tuple(fallbacks),
@@ -991,6 +1141,10 @@ def plan_decode_fastpath(
             wire_falloffs=tuple(wire_falloffs),
             wire_batch=int(batch_size),
             wire_specs=wire_specs or None,
+            reader_cols=reader_cols,
+            reader_falloffs=reader_falloffs,
+            reader_groups=reader_groups,
+            reader_planned=reader_planned,
         )
     except Exception:  # noqa: BLE001
         return None
@@ -1009,6 +1163,8 @@ def apply_decode_plan(table, plan: DecodePlan):
         cols_fast=len(plan.fast),
         cols_fallback=len(plan.fallbacks),
         cols_wire_fused=len(plan.wire_fused),
+        cols_reader=len(plan.reader_cols),
+        reader_groups=plan.reader_groups,
         workers=plan.workers,
     ):
         pass
@@ -1018,6 +1174,15 @@ def apply_decode_plan(table, plan: DecodePlan):
         # record the verdict even when it fused nothing, so the drift
         # pin sees 0 predicted == 0 observed rather than a missing series
         runtime.record_wire_fused(len(plan.wire_fused), plan.total)
+    if plan.reader_planned:
+        # same record-the-zeros contract for the reader chunk counters:
+        # chunk counts are STATIC (columns × non-pruned groups), the
+        # trace side of cost_drift's reader_chunks_native pin
+        native_chunks = len(plan.reader_cols) * plan.reader_groups
+        total_chunks = plan.total * plan.reader_groups
+        runtime.record_reader_chunks(
+            native_chunks, total_chunks - native_chunks, total_chunks
+        )
     if plan.fast:
         table = table.with_decode_fastpath(plan.fast)
     if plan.wire_specs:
@@ -1026,6 +1191,10 @@ def apply_decode_plan(table, plan: DecodePlan):
             table = with_wire(
                 runtime.WireFusionPlan(plan.wire_specs, plan.wire_batch)
             )
+    if plan.reader_cols:
+        with_reader = getattr(table, "with_native_reader", None)
+        if with_reader is not None:
+            table = with_reader(plan.reader_cols)
     return table
 
 

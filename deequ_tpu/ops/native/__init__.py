@@ -50,6 +50,7 @@ _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = (
     os.path.join(_PKG_DIR, "xxhash_hll.c"),
     os.path.join(_PKG_DIR, "decode.c"),
+    os.path.join(_PKG_DIR, "parquet_read.c"),
 )
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
@@ -82,11 +83,37 @@ def per_user_cache_dir() -> Optional[str]:
 
 
 def _cache_dirs():
-    """Candidate build dirs: the package itself, then the per-user cache."""
-    yield _PKG_DIR
+    """Candidate build dirs: the per-user cache first (keeps build
+    artifacts out of the package tree — they used to accumulate as
+    hash-named .so files next to the sources), then the package dir as
+    the fallback for environments without a writable temp dir."""
     user_dir = per_user_cache_dir()
     if user_dir is not None:
         yield user_dir
+    yield _PKG_DIR
+
+
+def _prune_stale_builds(directory: str, keep_digest: str) -> None:
+    """Remove cached `_deequ_native_*.so` files whose name does not start
+    with the current source digest (sanitize variants of the current
+    source share the digest prefix and survive). Best-effort: a cache
+    dir shared with a concurrently-running older version just means the
+    older process rebuilds on its next cold start."""
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return
+    prefix = f"_deequ_native_{keep_digest}"
+    for entry in entries:
+        if (
+            entry.startswith("_deequ_native_")
+            and entry.endswith(".so")
+            and not entry.startswith(prefix)
+        ):
+            try:
+                os.unlink(os.path.join(directory, entry))
+            except OSError:
+                pass
 
 
 def _sanitize_flags() -> list:
@@ -121,7 +148,8 @@ def _build_library() -> Optional[str]:
     for source in _SOURCES:
         with open(source, "rb") as f:
             h.update(f.read())
-    digest = h.hexdigest()[:16]
+    source_digest = h.hexdigest()[:16]
+    digest = source_digest
     sanitize = _sanitize_flags()
     if sanitize:
         tag = hashlib.sha256(" ".join(sanitize).encode()).hexdigest()[:8]
@@ -139,12 +167,15 @@ def _build_library() -> Optional[str]:
                     [compiler, "-O3", "-shared", "-fPIC"]
                     + sanitize
                     + list(_SOURCES)
-                    + ["-o", tmp],
+                    # parquet_read.c dlopens the decompressors and guards
+                    # codec init with pthread_once
+                    + ["-o", tmp, "-ldl", "-lpthread"],
                     check=True,
                     capture_output=True,
                     timeout=120,
                 )
                 os.replace(tmp, out)
+                _prune_stale_builds(directory, source_digest)
                 return out
             except (OSError, subprocess.SubprocessError):
                 if tmp is not None and os.path.exists(tmp):
@@ -360,6 +391,24 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int64,
             ]
             fn.restype = ctypes.c_int64
+        # parquet_read.c: native column-chunk reader (page headers,
+        # decompression, PLAIN/RLE-dict/RLE-bool decode into the same
+        # Arrow buffer layout decode.c consumes).
+        lib.pq_reader_codecs.argtypes = []
+        lib.pq_reader_codecs.restype = ctypes.c_int
+        lib.pq_decode_chunk.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.pq_decode_chunk.restype = ctypes.c_int64
         _LIB = lib
     except OSError:
         _LIB = None
@@ -1007,6 +1056,101 @@ def wire_primitive(
     if rc < 0:
         return None
     return rc
+
+
+#: arrow type token -> (allowed parquet physical types, engine numpy
+#: dtype name). The reader planner (ops/fused.py:classify_reader_columns)
+#: and the native reader dispatch both key off this map, so planner
+#: verdict and runtime capability can never disagree. uint32 may be
+#: stored as either INT64 (spec'd) or INT32 (writer-dependent); "bits"
+#: marks booleans, whose out buffer is an LSB bitmap.
+READER_TOKENS = {
+    "double": (("DOUBLE",), "float64"),
+    "float": (("FLOAT",), "float32"),
+    "int8": (("INT32",), "int8"),
+    "int16": (("INT32",), "int16"),
+    "int32": (("INT32",), "int32"),
+    "int64": (("INT64",), "int64"),
+    "uint8": (("INT32",), "uint8"),
+    "uint16": (("INT32",), "uint16"),
+    "uint32": (("INT64", "INT32"), "uint32"),
+    "uint64": (("INT64",), "uint64"),
+    "bool": (("BOOLEAN",), "bits"),
+}
+
+#: parquet physical-type name -> format enum (parquet_read.c)
+READER_PHYS_ENUM = {
+    "BOOLEAN": 0,
+    "INT32": 1,
+    "INT64": 2,
+    "FLOAT": 4,
+    "DOUBLE": 5,
+}
+
+#: parquet codec name -> format enum (parquet_read.c)
+READER_CODEC_ENUM = {"UNCOMPRESSED": 0, "SNAPPY": 1, "ZSTD": 6}
+
+#: parquet codec name -> pq_reader_codecs() capability bit
+READER_CODEC_MASK = {"UNCOMPRESSED": 1, "SNAPPY": 2, "ZSTD": 4}
+
+#: page encodings the native reader decodes; anything else (BIT_PACKED,
+#: DELTA_*, BYTE_STREAM_SPLIT) falls the column back to pyarrow
+READER_ENCODINGS = frozenset(
+    {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY"}
+)
+
+
+def reader_codecs() -> int:
+    """Bitmask of decompression codecs the native reader can use
+    (1=UNCOMPRESSED, 2=SNAPPY, 4=ZSTD — see READER_CODEC_MASK); 0 when
+    the native library is unavailable. Snappy/zstd load lazily via
+    dlopen, so the mask reflects what this host actually has."""
+    lib = _load()
+    if lib is None:
+        return 0
+    return int(lib.pq_reader_codecs())
+
+
+@_traced_kernel
+def read_chunk(
+    chunk: np.ndarray,
+    phys: int,
+    codec: int,
+    out_itemsize: int,
+    max_def: int,
+    num_values: int,
+    out_values: np.ndarray,
+    out_validity: Optional[np.ndarray],
+) -> Optional[tuple]:
+    """Decode one raw column-chunk byte range (dictionary page + data
+    pages) into caller-zeroed Arrow-layout buffers: `out_values` gets
+    contiguous engine-dtype values (LSB bitmap for booleans) with zeros
+    at null slots, `out_validity` (LSB bitmap, required when max_def==1)
+    gets its bits OR-set at non-null rows. Returns
+    (null_count, pages, uncompressed_bytes) or None on any decode error
+    — the caller falls back to pyarrow for that column, bit-identical."""
+    lib = _load()
+    if lib is None:
+        return None
+    info = np.zeros(3, dtype=np.int64)
+    rc = lib.pq_decode_chunk(
+        chunk.ctypes.data_as(ctypes.c_void_p),
+        int(len(chunk)),
+        int(phys),
+        int(codec),
+        int(out_itemsize),
+        int(max_def),
+        int(num_values),
+        out_values.ctypes.data_as(ctypes.c_void_p),
+        out_validity.ctypes.data_as(ctypes.c_void_p)
+        if out_validity is not None
+        else None,
+        info.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    rc = int(rc)
+    if rc < 0:
+        return None
+    return rc, int(info[0]), int(info[1])
 
 
 @_traced_kernel

@@ -264,6 +264,24 @@ def state_cache_enabled() -> bool:
     return os.environ.get("DEEQU_TPU_STATE_CACHE", "") not in ("0", "off")
 
 
+def native_reader_enabled() -> bool:
+    """Whether planner-approved column chunks may be read by the native
+    parquet reader (ops/native/parquet_read.c): page headers parsed,
+    page bodies decompressed (snappy/zstd via dlopen) and PLAIN /
+    RLE-dictionary / RLE-boolean values decoded straight into the same
+    Arrow-layout buffers the decode fast path consumes — pyarrow never
+    touches those chunks, and the read thread preads ahead of decode.
+
+    `DEEQU_TPU_NATIVE_READER=0` (or `off`) is the kill switch: every
+    chunk arrives through pyarrow exactly as before — the baseline the
+    reader differential suite compares against. The decode and wire
+    kernels see bit-identical buffers either way, so metrics are
+    bit-identical; only who produced the bytes changes."""
+    import os
+
+    return os.environ.get("DEEQU_TPU_NATIVE_READER", "") not in ("0", "off")
+
+
 def wire_pad_size(n: int, batch_size: int) -> int:
     """The fused pass's padded row length for an n-row batch (mirror of
     ops/fused.py:_pad_size, which delegates here): power of two, min 8,
@@ -588,6 +606,10 @@ def record_wire_fused(fused: int, total: int) -> None:
 
 def record_state_cache(cached: int, scanned: int, total: int) -> None:
     _counters.record_state_cache(cached, scanned, total)
+
+
+def record_reader_chunks(native: int, fallback: int, total: int) -> None:
+    _counters.record_reader_chunks(native, fallback, total)
 
 
 def pad_to(arr: np.ndarray, size: int) -> np.ndarray:

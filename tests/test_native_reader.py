@@ -1,0 +1,331 @@
+"""Native parquet column-chunk reader: unit + differential tests.
+
+Three layers (ISSUE 11):
+
+* chunk-level differential — every eligible column chunk decoded by
+  parquet_read.c (through `native_reader.decode_chunk`) must match the
+  pyarrow read of the same row group bit for bit: null counts, validity
+  bits, and raw value bits (floats compared via uint views so NaN
+  payloads and signed zeros count);
+* robustness — truncated chunks, corrupt Thrift varints, an oversized
+  uncompressed_page_size, and random byte corruption must yield a clean
+  None (pyarrow fallback), never a crash or an exception;
+* assembly — `assemble_column` walks multi-group segment lists through
+  the same decode.c kernels the Arrow fast path feeds; its output must
+  agree with the pure-numpy mirror on every slice, including slices
+  crossing row-group boundaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from deequ_tpu.data import native_reader as nr
+from deequ_tpu.data.source import ParquetSource
+from deequ_tpu.ops import native, runtime
+
+requires_native = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+def _codec_names():
+    mask = native.reader_codecs()
+    return [
+        name
+        for name, bit in native.READER_CODEC_MASK.items()
+        if mask & bit
+    ]
+
+
+def _mixed_table(n=4000, seed=7):
+    rng = np.random.default_rng(seed)
+    d = rng.normal(size=n)
+    d[rng.random(n) < 0.1] = np.nan
+    return pa.table(
+        {
+            "d": pa.array(d, mask=rng.random(n) < 0.2),
+            "f": pa.array(rng.normal(size=n).astype(np.float32)),
+            "i64": pa.array(
+                rng.integers(-(10**12), 10**12, size=n),
+                mask=rng.random(n) < 0.3,
+            ),
+            "i32": pa.array(rng.integers(-(2**31), 2**31, size=n).astype(np.int32)),
+            "u8": pa.array(rng.integers(0, 256, size=n).astype(np.uint8)),
+            "b": pa.array(rng.random(n) < 0.5, mask=rng.random(n) < 0.1),
+            # low-cardinality double: stays dictionary-encoded on disk
+            "dictish": pa.array((rng.integers(0, 8, size=n) * 1.5).astype(np.float64)),
+        }
+    )
+
+
+def _write(table, path, codec, version="2.6", **kw):
+    pq.write_table(
+        table,
+        path,
+        compression=codec if codec != "UNCOMPRESSED" else "NONE",
+        version=version,
+        data_page_size=4096,
+        row_group_size=max(1, table.num_rows // 2),
+        **kw,
+    )
+
+
+def _metas(path, columns):
+    """The source's own per-(group, column) native decode recipes."""
+    src = ParquetSource(str(path))
+    return src._reader_chunk_meta(frozenset(columns)), src
+
+
+def _decode_all(path, metas):
+    fd = os.open(str(path), os.O_RDONLY)
+    try:
+        out = {}
+        for key, meta in metas.items():
+            raw = nr.fetch_chunk(fd, meta)
+            assert raw is not None, key
+            out[key] = nr.decode_chunk(raw, meta)
+        return out
+    finally:
+        os.close(fd)
+
+
+@requires_native
+@pytest.mark.parametrize("codec", _codec_names() or ["UNCOMPRESSED"])
+@pytest.mark.parametrize("version", ["1.0", "2.6"])
+def test_decode_chunk_bit_identical_to_pyarrow(tmp_path, codec, version):
+    if codec not in _codec_names():
+        pytest.skip(f"{codec} not loadable here")
+    table = _mixed_table()
+    path = tmp_path / f"mix_{codec}_{version}.parquet"
+    _write(table, path, codec, version=version)
+    cols = list(table.column_names)
+    metas, _ = _metas(path, cols)
+    assert metas, "no chunk proved eligible — recipe builder regressed"
+    # every column of this table is reader-eligible; both row groups too
+    pf = pq.ParquetFile(str(path))
+    assert len(metas) == pf.metadata.num_row_groups * len(cols)
+
+    decoded = _decode_all(path, metas)
+    for (g, name), seg in decoded.items():
+        assert seg is not None, (g, name)
+        ref = pf.read_row_group(g, columns=[name]).column(0).combine_chunks()
+        assert seg.null_count == ref.null_count, (g, name)
+        nv = seg.num_values
+        ref_valid = ~np.asarray(ref.is_null())
+        if seg.validity is not None:
+            got_valid = np.unpackbits(seg.validity, bitorder="little")[:nv].astype(bool)
+        else:
+            got_valid = np.ones(nv, dtype=bool)
+        assert np.array_equal(got_valid, ref_valid), (g, name)
+        fill = False if seg.token == "bool" else 0
+        ref_np = np.asarray(ref.fill_null(fill).to_numpy(zero_copy_only=False))
+        if seg.token == "bool":
+            got = np.unpackbits(seg.values, bitorder="little")[:nv].astype(bool)
+            # null slots decode to 0 bits; compare where valid
+            assert np.array_equal(got[got_valid], ref_np[got_valid]), (g, name)
+        elif seg.token in ("double", "float"):
+            uint = np.uint64 if seg.token == "double" else np.uint32
+            a = seg.values[got_valid].view(uint)
+            b = ref_np.astype(seg.values.dtype)[got_valid].view(uint)
+            assert np.array_equal(a, b), (g, name)
+        else:
+            a = seg.values[got_valid]
+            b = ref_np[got_valid].astype(seg.values.dtype)
+            assert np.array_equal(a, b), (g, name)
+        assert seg.pages >= 1
+        assert seg.uncompressed_bytes > 0
+
+
+def _one_chunk(tmp_path, name="plain", use_dictionary=True):
+    """One eligible UNCOMPRESSED chunk's (raw bytes, meta)."""
+    rng = np.random.default_rng(13)
+    n = 2000
+    table = pa.table(
+        {"x": pa.array(rng.normal(size=n), mask=rng.random(n) < 0.2)}
+    )
+    path = tmp_path / f"{name}.parquet"
+    pq.write_table(
+        table,
+        path,
+        compression="NONE",
+        data_page_size=4096,
+        row_group_size=n,
+        use_dictionary=use_dictionary,
+    )
+    metas, _ = _metas(path, ["x"])
+    assert len(metas) == 1
+    meta = metas[0, "x"]
+    fd = os.open(str(path), os.O_RDONLY)
+    try:
+        raw = nr.fetch_chunk(fd, meta)
+    finally:
+        os.close(fd)
+    assert raw is not None
+    assert nr.decode_chunk(raw, meta) is not None, "healthy chunk must decode"
+    return raw, meta
+
+
+@requires_native
+def test_decode_chunk_truncated_page_returns_none(tmp_path):
+    raw, meta = _one_chunk(tmp_path)
+    for cut in (0, 1, 3, len(raw) // 4, len(raw) // 2, len(raw) - 1):
+        assert nr.decode_chunk(raw[:cut].copy(), meta) is None, cut
+
+
+@requires_native
+def test_decode_chunk_corrupt_thrift_varint_returns_none(tmp_path):
+    raw, meta = _one_chunk(tmp_path)
+    # a compact-Thrift varint with no terminating byte: ten 0xFF
+    # continuation bytes where the page header starts
+    bad = raw.copy()
+    bad[: min(10, len(bad))] = 0xFF
+    assert nr.decode_chunk(bad, meta) is None
+
+
+@requires_native
+def test_decode_chunk_oversized_uncompressed_size_returns_none(tmp_path):
+    # PLAIN data page first (no dict page): the chunk begins with the
+    # compact-Thrift PageHeader — field 1 (type, header byte 0x15) then
+    # its varint, field 2 (uncompressed_page_size, 0x15) then its
+    # varint. Splice a 5-byte ~2^34 varint in place of that size.
+    raw, meta = _one_chunk(tmp_path, name="nodict", use_dictionary=False)
+    assert raw[0] == 0x15
+    i = 1
+    while raw[i] & 0x80:
+        i += 1
+    i += 1  # past the type varint
+    assert raw[i] == 0x15
+    j = i + 1
+    while raw[j] & 0x80:
+        j += 1
+    j += 1  # past the original uncompressed_page_size varint
+    huge = np.frombuffer(b"\xff\xff\xff\xff\x7f", dtype=np.uint8)
+    bad = np.concatenate([raw[: i + 1], huge, raw[j:]])
+    assert nr.decode_chunk(bad, meta) is None
+
+
+@requires_native
+def test_decode_chunk_random_corruption_never_raises(tmp_path):
+    raw, meta = _one_chunk(tmp_path)
+    rng = np.random.default_rng(29)
+    for trial in range(150):
+        bad = raw.copy()
+        if trial % 3 == 0:
+            bad = bad[: int(rng.integers(0, len(bad)))].copy()
+        else:
+            for _ in range(int(rng.integers(1, 8))):
+                bad[int(rng.integers(0, len(bad)))] = int(rng.integers(0, 256))
+        if len(bad) == 0:
+            bad = np.zeros(0, dtype=np.uint8)
+        # must return a DecodedChunk or None — never raise, never crash
+        out = nr.decode_chunk(bad, meta)
+        assert out is None or isinstance(out, nr.DecodedChunk)
+
+
+@requires_native
+def test_fetch_chunk_short_read_returns_none(tmp_path):
+    raw, meta = _one_chunk(tmp_path)
+    path = tmp_path / "plain.parquet"
+    size = os.path.getsize(path)
+    beyond = dataclasses.replace(meta, offset=max(0, size - 8), nbytes=4096)
+    fd = os.open(str(path), os.O_RDONLY)
+    try:
+        assert nr.fetch_chunk(fd, beyond) is None
+        assert nr.fetch_chunk(fd, meta) is not None
+    finally:
+        os.close(fd)
+
+
+def test_segment_overlaps_walk():
+    def seg(nv):
+        return nr.DecodedChunk(
+            token="double",
+            values=np.zeros(nv),
+            validity=None,
+            null_count=0,
+            num_values=nv,
+            pages=1,
+            uncompressed_bytes=nv * 8,
+        )
+    segs = [seg(100), seg(50), seg(100)]
+    assert nr._segment_overlaps(segs, 0, 100) == [(segs[0], 0, 100)]
+    assert nr._segment_overlaps(segs, 90, 160) == [
+        (segs[0], 90, 100),
+        (segs[1], 0, 50),
+        (segs[2], 0, 10),
+    ]
+    assert nr._segment_overlaps(segs, 150, 250) == [(segs[2], 0, 100)]
+    assert nr._segment_overlaps(segs, 250, 260) == []
+
+
+@requires_native
+@pytest.mark.parametrize("column", ["d", "i64", "u8", "b"])
+def test_assemble_column_matches_numpy_mirror(tmp_path, column):
+    table = _mixed_table(n=3000, seed=17)
+    path = tmp_path / "assemble.parquet"
+    _write(table, path, "UNCOMPRESSED")
+    metas, _ = _metas(path, [column])
+    decoded = _decode_all(path, metas)
+    segments = [decoded[key] for key in sorted(decoded)]
+    assert all(s is not None for s in segments)
+    token = segments[0].token
+    total = sum(s.num_values for s in segments)
+    # slices inside one group, crossing the group boundary, and full
+    half = total // 2
+    for start, stop in [(0, 500), (half - 250, half + 250), (0, total)]:
+        got = nr.assemble_column(column, token, segments, start, stop, {})
+        ref = nr._assemble_column_numpy_fallback(
+            column, token, segments, start, stop
+        )
+        assert got is not None
+        gv, rv = np.asarray(got.values), np.asarray(ref.values)
+        if gv.dtype.kind == "f":
+            assert np.array_equal(gv.view(np.uint64), rv.view(np.uint64))
+        else:
+            assert np.array_equal(gv, rv)
+        assert np.array_equal(np.asarray(got.valid), np.asarray(ref.valid))
+
+
+@requires_native
+def test_classifier_names_the_disqualifying_property(tmp_path):
+    """classify_reader_columns' falloff reasons are per-column and name
+    the property that disqualified the chunk (DQ315's message body)."""
+    from deequ_tpu.ops.fused import classify_reader_columns
+
+    n = 1000
+    table = pa.table(
+        {
+            "ok": pa.array(np.arange(n, dtype=np.float64)),
+            "s": pa.array(["x"] * n),
+        }
+    )
+    path = tmp_path / "cls.parquet"
+    _write(table, path, "UNCOMPRESSED")
+    src = ParquetSource(str(path))
+    groups = src.row_group_stats()
+    col_types = {"ok": "double", "s": "string"}
+    mask = native.reader_codecs()
+    cols, falloffs, n_groups = classify_reader_columns(col_types, groups, mask)
+    assert cols == ["ok"]
+    assert n_groups == len(groups)
+    reasons = dict(falloffs)
+    assert "no native page decoder" in reasons["s"]
+
+    # codec library mask of 0 disqualifies everything, with the reason
+    cols0, falloffs0, _ = classify_reader_columns(col_types, groups, 0)
+    assert cols0 == []
+    assert all("codec" in r or "decoder" in r for _, r in falloffs0)
+
+
+def test_kill_switch_disables_reader(monkeypatch):
+    monkeypatch.setenv("DEEQU_TPU_NATIVE_READER", "0")
+    assert not runtime.native_reader_enabled()
+    monkeypatch.setenv("DEEQU_TPU_NATIVE_READER", "1")
+    assert runtime.native_reader_enabled()

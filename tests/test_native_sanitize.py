@@ -164,6 +164,46 @@ assert [int(v) if m else None for v, m in zip(wvi, im)] == ia.to_pylist()
 wbv = np.zeros(128, dtype=np.uint8)
 rcv = native.wire_valid_bits(iab[0].address, ia.offset, len(ia), wbv, 9)
 assert rcv == rci
+
+# native parquet page decode (parquet_read.c) while instrumented: a
+# real column chunk (Thrift headers, dict + data pages) decoded into
+# arrow-layout buffers, then truncated and bit-flipped variants which
+# must fail cleanly without reading or writing out of bounds
+import os as _os
+import tempfile as _tempfile
+
+import pyarrow.parquet as _pq
+
+_n = 3000
+_tbl = pa.table({"x": pa.array([float(i) if i % 7 else None for i in range(_n)])})
+_tmp = _tempfile.mkstemp(suffix=".parquet")[1]
+_pq.write_table(
+    _tbl, _tmp, compression="NONE", data_page_size=1024, row_group_size=_n
+)
+_md = _pq.ParquetFile(_tmp).metadata
+_ch = _md.row_group(0).column(0)
+_start = _ch.data_page_offset
+if _ch.has_dictionary_page and _ch.dictionary_page_offset is not None:
+    _start = min(_start, _ch.dictionary_page_offset)
+with open(_tmp, "rb") as _f:
+    _f.seek(_start)
+    _chunk = np.frombuffer(_f.read(_ch.total_compressed_size), dtype=np.uint8)
+_os.unlink(_tmp)
+_vals = np.zeros(_n, dtype=np.float64)
+_valid = np.zeros((_n + 7) // 8, dtype=np.uint8)
+res = native.read_chunk(_chunk, 5, 0, 8, 1, _n, _vals, _valid)
+assert res is not None and res[0] == _tbl.column("x").null_count
+_rngc = np.random.default_rng(23)
+for _t in range(60):
+    _bad = _chunk.copy()
+    if _t % 2:
+        _bad = _bad[: int(_rngc.integers(0, len(_bad)))].copy()
+    else:
+        for _ in range(4):
+            _bad[int(_rngc.integers(0, len(_bad)))] = int(_rngc.integers(0, 256))
+    _vals[:] = 0
+    _valid[:] = 0
+    native.read_chunk(_bad, 5, 0, 8, 1, _n, _vals, _valid)
 print("SANITIZED_OK")
 """
 
@@ -271,6 +311,34 @@ _ab = shared_arrow.buffers()
 N_SEG = n // N_THREADS  # byte-aligned: n and N_THREADS are powers of 2
 shared_wire_bits = np.zeros(n // 8, dtype=np.uint8)
 
+# one shared raw parquet chunk every thread page-decodes concurrently —
+# the native reader's decode-worker shape (threads share the chunk
+# bytes read-only, each writes its own output buffers)
+import os as _os
+import tempfile as _tempfile
+
+import pyarrow.parquet as _pq
+
+_cn = 2000
+_ctbl = pa.table(
+    {"x": pa.array([float(i) if i % 7 else None for i in range(_cn)])}
+)
+_ctmp = _tempfile.mkstemp(suffix=".parquet")[1]
+_pq.write_table(
+    _ctbl, _ctmp, compression="NONE", data_page_size=1024, row_group_size=_cn
+)
+_cch = _pq.ParquetFile(_ctmp).metadata.row_group(0).column(0)
+_cstart = _cch.data_page_offset
+if _cch.has_dictionary_page and _cch.dictionary_page_offset is not None:
+    _cstart = min(_cstart, _cch.dictionary_page_offset)
+with open(_ctmp, "rb") as _cf:
+    _cf.seek(_cstart)
+    shared_chunk = np.frombuffer(
+        _cf.read(_cch.total_compressed_size), dtype=np.uint8
+    )
+_os.unlink(_ctmp)
+shared_chunk_nulls = _ctbl.column("x").null_count
+
 def work(seed):
     r = np.random.default_rng(seed)
     x = r.random(n)
@@ -306,6 +374,10 @@ def work(seed):
             shared_wire_bits, off,
         )
         assert rcw is not None and rcw >= 0
+        cv = np.zeros(_cn, dtype=np.float64)
+        cb = np.zeros((_cn + 7) // 8, dtype=np.uint8)
+        cres = native.read_chunk(shared_chunk, 5, 0, 8, 1, _cn, cv, cb)
+        assert cres is not None and cres[0] == shared_chunk_nulls
     # deterministic reference: same shared inputs -> same moments
     mom = native.masked_moments_select(
         shared_x, shared_valid, shared_where, cap=128

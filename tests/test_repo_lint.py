@@ -502,3 +502,74 @@ def test_decode_rule_covers_the_fastpath_modules():
     sep = os.sep
     assert f"deequ_tpu{sep}data{sep}arrow_decode.py" in lint.DECODE_FILES
     assert f"deequ_tpu{sep}ops{sep}native{sep}__init__.py" in lint.DECODE_FILES
+
+
+# -- READER: no pyarrow on the native-reader path (ISSUE 11 satellite) --------
+
+
+def test_reader_checker_flags_pyarrow_import_even_lazy():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def fetch_chunk(fd, meta):\n"
+        "    import pyarrow.parquet as pq\n"
+        "    return pq.ParquetFile(meta.path)\n"
+    )
+    try:
+        findings = lint.check_reader_purity(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+    assert "READER" in findings[0] and "pyarrow" in findings[0]
+
+
+def test_reader_checker_flags_top_level_pyarrow_import():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import pyarrow as pa\n"
+        "def decode(raw):\n"
+        "    return pa.py_buffer(raw)\n"
+    )
+    try:
+        findings = lint.check_reader_purity(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+
+
+def test_reader_checker_allows_designated_fallback_functions():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def _assemble_column_numpy_fallback(segments):\n"
+        "    import pyarrow as pa\n"
+        "    return pa.nulls(0)\n"
+    )
+    try:
+        findings = lint.check_reader_purity(path)
+    finally:
+        os.unlink(path)
+    assert findings == []
+
+
+def test_reader_checker_allows_native_path_code():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import os\n"
+        "import numpy as np\n"
+        "from deequ_tpu.ops import native\n"
+        "def fetch_chunk(fd, meta):\n"
+        "    return os.pread(fd, meta.nbytes, meta.offset)\n"
+    )
+    try:
+        findings = lint.check_reader_purity(path)
+    finally:
+        os.unlink(path)
+    assert findings == []
+
+
+def test_reader_rule_covers_the_dispatch_module():
+    lint = _lint_module()
+    sep = os.sep
+    rels = set(lint.READER_FILES)
+    assert f"deequ_tpu{sep}data{sep}native_reader.py" in rels
+    for rel in rels:
+        assert os.path.exists(os.path.join(REPO, rel)), rel

@@ -671,7 +671,10 @@ def test_decode_fastpath_workers_bit_identical(layout, seed, monkeypatch, tmp_pa
         "no column took the fast path",
         layout,
     )
-    assert any(sp.name == "decode_unit" for sp in spans), (
+    # the pool's per-unit span is decode_unit on the pyarrow parallel
+    # path and page_decode on the native-reader path (ISSUE 11), which
+    # takes over the scan whenever any column has a native page recipe
+    assert any(sp.name in ("decode_unit", "page_decode") for sp in spans), (
         "parallel decode workers never engaged"
     )
 
@@ -740,6 +743,95 @@ def test_wire_fusion_bit_identical(layout, seed, monkeypatch, tmp_path):
     )
     assert tracer.counters.get("wire_cols_total", 0) > 0, (
         "wire planning never recorded its verdict"
+    )
+
+
+# -- native parquet reader on/off differential (ISSUE 11) --------------------
+
+
+@pytest.mark.parametrize(
+    "layout,seed",
+    [(layout, seed) for layout in ("narrow", "wide", "lineitem") for seed in range(2)],
+)
+def test_native_reader_bit_identical(layout, seed, monkeypatch, tmp_path):
+    """DEEQU_TPU_NATIVE_READER=0 (pyarrow produces every buffer) vs =1
+    (planner-approved chunks pread and page-decoded by parquet_read.c)
+    must be BIT-identical — exact snapshot equality, sketches included —
+    across worker counts 1 vs 3, BOTH placements, and BOTH parquet
+    format versions (V1 and V2 data pages): the reader changes who
+    produces the bytes, never one bit of any value, mask or dictionary
+    code. NaN/NULL-heavy layouts run so validity bitmaps and NaN folds
+    cross both producers. Under a tracer the reader must actually
+    engage (page_read/page_decode spans, reader_chunks_native > 0) and
+    the traced per-unit chunk counts must sum to exactly the planner's
+    static prediction — the runtime twin of drift.reader_chunks_native
+    staying pinned at 0."""
+    import pyarrow.parquet as pq
+
+    from deequ_tpu import observe
+    from deequ_tpu.data.table import Table as TableCls
+    from deequ_tpu.ops import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+
+    rng = np.random.default_rng(16_000 + seed)
+    table = LAYOUTS[layout](rng)
+    n = table.num_rows
+    roles = layout_roles(layout, rng)
+    checks = [random_check(rng, roles) for _ in range(int(rng.integers(1, 3)))]
+    version = "1.0" if seed % 2 == 0 else "2.6"
+
+    path = str(tmp_path / "reader.parquet")
+    table.to_parquet(
+        path, row_group_size=max(64, n // 7), dictionary_encode_strings=True
+    )
+    # rewrite at the target format version: V1 data pages compress the
+    # definition levels with the values, V2 pages carry them raw — the
+    # native page parser must take both to the same bits
+    pq.write_table(
+        pq.read_table(path),
+        path,
+        version=version,
+        row_group_size=max(64, n // 7),
+        data_page_size=4096,
+    )
+
+    def run(reader_env, workers_env, placement):
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+        monkeypatch.setenv("DEEQU_TPU_NATIVE_READER", reader_env)
+        monkeypatch.setenv("DEEQU_TPU_DECODE_WORKERS", workers_env)
+        data = TableCls.scan_parquet(path, batch_rows=max(64, n // 5))
+        builder = VerificationSuite().on_data(data)
+        for check in checks:
+            builder = builder.add_check(check)
+        return suite_snapshot(builder.with_engine("single").run())
+
+    for placement in ("host", "device"):
+        baseline = run("0", "1", placement)
+        for reader, workers in (("1", "1"), ("0", "3"), ("1", "3")):
+            assert run(reader, workers, placement) == baseline, (
+                layout, seed, placement, reader, workers,
+            )
+
+    host_baseline = run("0", "1", "host")
+    with observe.tracing() as tracer:
+        traced = run("1", "3", "host")
+    assert traced == host_baseline, ("tracing changed results", layout, seed)
+    spans = [sp for root in tracer.roots for sp in _iter_spans(root)]
+    reads = [sp for sp in spans if sp.name == "page_read"]
+    decodes = [sp for sp in spans if sp.name == "page_decode"]
+    assert reads, "read-ahead fetch thread never produced a page_read span"
+    assert decodes, "native reader never produced a page_decode span"
+    runtime_native = sum(sp.attrs.get("chunks_native", 0) for sp in decodes)
+    assert runtime_native > 0, ("no chunk decoded natively", layout, seed)
+    planned_native = tracer.counters.get("reader_chunks_native", 0)
+    assert tracer.counters.get("reader_chunks_total", 0) > 0, (
+        "reader verdict never recorded"
+    )
+    assert runtime_native == planned_native, (
+        "runtime chunk split drifted from the static plan",
+        layout, seed, runtime_native, planned_native,
     )
 
 

@@ -457,6 +457,12 @@ class TestEndToEnd:
 
         monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "device")
         monkeypatch.setenv("DEEQU_TPU_DECODE_WORKERS", "1")
+        # pin the ARROW decode route: this test watches the per-batch
+        # arrow_decode wire_fuse counts and the sticky-shift handshake,
+        # which the native parquet reader (ISSUE 11) replaces with
+        # assemble_wire_column — engagement there is pinned by the
+        # wire fuzz differential's cols_wire_fused check instead
+        monkeypatch.setenv("DEEQU_TPU_NATIVE_READER", "0")
         path = _write_numeric_parquet(tmp_path)
         with observe.tracing() as tracer:
             AnalysisRunner().on_data(

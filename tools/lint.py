@@ -45,6 +45,15 @@ Checks:
              path's whole point is ONE native pass from arrow buffers
              to Column backing; a host-copy idiom silently reintroduces
              the intermediate materialization it exists to remove.
+  READER   — the native parquet reader dispatch
+             (deequ_tpu/data/native_reader.py) must not import pyarrow
+             outside designated fallback functions (names ending
+             `_fallback`): the module exists to own the bytes end to
+             end — pread → page decode → arrow-layout buffers → the
+             decode/wire kernels — and a pyarrow import on the native
+             path means the arrow materialization it replaces crept
+             back in. Per-column fallbacks live in source.py, which
+             already holds the pyarrow reader.
   SERDE    — no `pickle` (import or call) in the state serde paths
              (deequ_tpu/repository/states.py,
              deequ_tpu/analyzers/state_provider.py): persisted analyzer
@@ -110,6 +119,10 @@ DECODE_FILES = [
     os.path.join("deequ_tpu", "data", "arrow_decode.py"),
     os.path.join("deequ_tpu", "ops", "native", "__init__.py"),
 ]
+# Native-reader dispatch: pyarrow must not appear outside designated
+# `*_fallback` functions — the module owns the bytes end to end.
+READER_FILES = [os.path.join("deequ_tpu", "data", "native_reader.py")]
+READER_FORBIDDEN_MODULES = {"pyarrow"}
 # State serde paths: pickle is banned in any form (import, from-import,
 # attribute call) — persisted states are versioned exact-width binary.
 SERDE_FILES = [
@@ -306,6 +319,43 @@ def check_pushdown_purity(path: str) -> List[str]:
                 f"stats interpreter — it must never touch files; pass "
                 f"RowGroupStats in"
             )
+    return findings
+
+
+# -- READER: no pyarrow on the native-reader path ------------------------------
+
+
+def check_reader_purity(path: str) -> List[str]:
+    """Flag pyarrow imports in the native-reader dispatch outside
+    designated fallback functions (any enclosing function whose name
+    ends `_fallback`). The module's contract is page bytes straight to
+    arrow-layout buffers through the native kernels; a pyarrow import on
+    that path reintroduces the materialization the reader removes."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    findings: List[str] = []
+
+    def walk(node: ast.AST, in_fallback: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            in_fallback = in_fallback or node.name.endswith("_fallback")
+        if not in_fallback:
+            modules: List[str] = []
+            if isinstance(node, ast.Import):
+                modules = [alias.name for alias in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                modules = [node.module]
+            for mod in modules:
+                if mod.split(".")[0] in READER_FORBIDDEN_MODULES:
+                    findings.append(
+                        f"{_rel(path)}:{node.lineno}: READER `{mod}` import "
+                        f"on the native reader path — the reader owns the "
+                        f"bytes end to end; arrow fallbacks live in "
+                        f"source.py or a designated `*_fallback` function"
+                    )
+        for child in ast.iter_child_nodes(node):
+            walk(child, in_fallback)
+
+    walk(tree, False)
     return findings
 
 
@@ -685,6 +735,11 @@ def main() -> int:
         path = os.path.join(REPO, rel)
         if os.path.exists(path):
             findings.extend(check_decode_copies(path))
+
+    for rel in READER_FILES:
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):
+            findings.extend(check_reader_purity(path))
 
     for rel in SERDE_FILES:
         path = os.path.join(REPO, rel)

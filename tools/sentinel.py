@@ -62,6 +62,11 @@ WATCHED_SERIES: Sequence[Tuple[str, str]] = (
     # to the Column path (consumer set widened, sticky spec lost, wire
     # kernels unavailable)
     ("engine.wire_fused_ratio", "down"),
+    # native parquet reader effectiveness: the fraction of fast-path
+    # column-chunks decoded by the page-to-wire reader; a drop means
+    # chunks fell back to arrow (codec library vanished, writer switched
+    # to an unsupported page encoding, chunk layout metadata lost)
+    ("engine.reader_native_ratio", "down"),
     # state-cache effectiveness: the fraction of dataset partitions whose
     # analyzer states loaded from the persistent partition-state cache
     # instead of rescanning; a drop means incremental runs stopped
