@@ -480,6 +480,11 @@ static int64_t hybrid_u32(const uint8_t *in, int64_t in_len, int bw,
         } else {
             int64_t groups = (int64_t)(header >> 1);
             if (groups <= 0) return PQE_THRIFT;
+            /* bw >= 1 here, so every group consumes at least one input
+             * byte; bounding groups by the remaining bytes before the
+             * multiplications keeps nvals/nbytes from overflowing on
+             * corrupt varint group counts (up to 2^62). */
+            if (groups > (int64_t)(t.end - t.p)) return PQE_TRUNCATED;
             int64_t nvals = groups * 8;
             int64_t nbytes = groups * bw;
             if ((int64_t)(t.end - t.p) < nbytes) return PQE_TRUNCATED;
@@ -883,7 +888,12 @@ int64_t pq_decode_chunk(const uint8_t *chunk, int64_t chunk_len, int32_t phys,
                 goto done;
             }
             int src_size = phys_itemsize(phys);
-            if (h.dict_num_values * src_size > h.uncompressed_size) {
+            /* divide instead of multiply: dict_num_values * src_size can
+             * wrap past int64 on corrupt headers and slip under
+             * uncompressed_size. uncompressed_size is already bounded to
+             * [0, MAX_PAGE_BYTES] by parse_page_header, so this also caps
+             * dict_num_values (and the malloc below) at MAX_PAGE_BYTES. */
+            if (h.dict_num_values > h.uncompressed_size / src_size) {
                 rc = PQE_SIZE;
                 goto done;
             }

@@ -229,6 +229,121 @@ def test_decode_chunk_random_corruption_never_raises(tmp_path):
         assert out is None or isinstance(out, nr.DecodedChunk)
 
 
+# ---- directed structural corruption ----
+#
+# Byte-wise fuzzing of a valid chunk cannot plausibly synthesize the
+# multi-byte varints (bit-packed group counts ~2^58, dictionary counts
+# ~2^61) that reach the int64-overflow guards in hybrid_u32 and the
+# dictionary-page size check, so these chunks are crafted by hand with a
+# minimal compact-Thrift emitter.
+
+
+def _uvarint(v):
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _zz(v):
+    assert v >= 0
+    return _uvarint(v << 1)
+
+
+def _page_header(ptype, size, struct_fid, fields):
+    """Compact-Thrift PageHeader: type/sizes then one nested struct whose
+    int fields are all emitted as zigzag-varint i32 (ftype 5)."""
+    out = bytearray()
+    prev = 0
+    for fid, val in ((1, ptype), (2, size), (3, size)):
+        out.append(((fid - prev) << 4) | 0x05)
+        out += _zz(val)
+        prev = fid
+    out.append(((struct_fid - prev) << 4) | 0x0C)
+    sprev = 0
+    for fid, val in fields:
+        out.append(((fid - sprev) << 4) | 0x05)
+        out += _zz(val)
+        sprev = fid
+    out.append(0)  # struct STOP
+    out.append(0)  # PageHeader STOP
+    return bytes(out)
+
+
+def _dict_page(num_values, body):
+    # PAGE_DICT, DictionaryPageHeader at fid 7: (num_values, PLAIN)
+    return _page_header(2, len(body), 7, [(1, num_values), (2, 0)]) + body
+
+
+def _dict_data_page(num_values, body):
+    # PAGE_DATA, DataPageHeader at fid 5: (num_values, RLE_DICT, RLE defs)
+    return _page_header(0, len(body), 5, [(1, num_values), (2, 8), (3, 3)]) + body
+
+
+def _rle_defs(n):
+    run = _uvarint(n << 1) + b"\x01"  # one RLE run of n ones (no nulls)
+    return len(run).to_bytes(4, "little") + run
+
+
+def _read_crafted(chunk_bytes, n):
+    vals = np.zeros(n, dtype=np.float64)
+    valid = np.zeros((n + 7) // 8, dtype=np.uint8)
+    chunk = np.frombuffer(chunk_bytes, dtype=np.uint8)
+    return native.read_chunk(chunk, 5, 0, 8, 1, n, vals, valid), vals, valid
+
+
+@requires_native
+def test_decode_chunk_crafted_control_decodes():
+    # sanity for the emitter itself: a healthy hand-built chunk must
+    # decode, so the corruption tests below cannot pass vacuously on an
+    # unrelated parse error
+    n = 8
+    dict_body = np.arange(4, dtype=np.float64).tobytes()
+    idx = bytes([2, 0x03, 0xE4, 0xE4])  # bw=2, 1 group: 0,1,2,3,0,1,2,3
+    chunk = _dict_page(4, dict_body) + _dict_data_page(n, _rle_defs(n) + idx)
+    res, vals, valid = _read_crafted(chunk, n)
+    assert res is not None and res[0] == 0
+    assert np.array_equal(vals, np.tile(np.arange(4.0), 2))
+    assert valid[0] == 0xFF
+
+
+@requires_native
+def test_decode_chunk_huge_bitpacked_group_count_fails_closed(tmp_path):
+    # a bit-packed hybrid header declaring ~2^58 groups at bit width 32:
+    # groups*8 and groups*bw overflow int64, and an overflowed negative
+    # byte count would bypass the truncation check and send unpack8 far
+    # past the input buffer; the decoder must reject before multiplying
+    n = 64
+    dict_body = np.arange(4, dtype=np.float64).tobytes()
+    for groups in (1 << 58, 1 << 60, (1 << 63) - 1):
+        idx = bytes([32]) + _uvarint((groups << 1) | 1) + b"\x00" * 8
+        chunk = _dict_page(4, dict_body) + _dict_data_page(
+            n, _rle_defs(n) + idx
+        )
+        res, _, _ = _read_crafted(chunk, n)
+        assert res is None, hex(groups)
+
+
+@requires_native
+def test_decode_chunk_huge_dict_count_fails_closed(tmp_path):
+    # dict_num_values ~2^61 with an 8-byte page body: the old multiply
+    # dict_num_values*src_size wrapped past int64 (to 0, 8, or negative)
+    # and slipped under uncompressed_size, leaving dict_count huge so
+    # every index passed validation and gathered from an empty buffer;
+    # the size check must reject via division instead
+    n = 8
+    data_body = _rle_defs(n) + bytes([1, 0x03, 0xFF])  # bw=1, indices all 1
+    for count in (1 << 61, (1 << 61) + 1, (1 << 60) + 1):
+        chunk = _dict_page(count, b"\x00" * 8) + _dict_data_page(n, data_body)
+        res, _, _ = _read_crafted(chunk, n)
+        assert res is None, hex(count)
+
+
 @requires_native
 def test_fetch_chunk_short_read_returns_none(tmp_path):
     raw, meta = _one_chunk(tmp_path)

@@ -204,6 +204,54 @@ for _t in range(60):
     _vals[:] = 0
     _valid[:] = 0
     native.read_chunk(_bad, 5, 0, 8, 1, _n, _vals, _valid)
+
+# directed structural corruption while instrumented: extreme multi-byte
+# varints that byte-wise fuzzing cannot synthesize. A bit-packed group
+# count ~2^58 at bit width 32 and a dictionary count ~2^61 each used to
+# overflow int64 size math and read out of bounds; both must now fail
+# closed with no sanitizer report.
+def _uv(v):
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+def _hdr(ptype, size, sfid, fields):
+    out = bytearray()
+    prev = 0
+    for fid, val in ((1, ptype), (2, size), (3, size)):
+        out.append(((fid - prev) << 4) | 0x05)
+        out += _uv(val << 1)
+        prev = fid
+    out.append(((sfid - prev) << 4) | 0x0C)
+    sprev = 0
+    for fid, val in fields:
+        out.append(((fid - sprev) << 4) | 0x05)
+        out += _uv(val << 1)
+        sprev = fid
+    return bytes(out) + b"\x00\x00"
+
+_drun = _uv(8 << 1) + b"\x01"
+_defs8 = len(_drun).to_bytes(4, "little") + _drun
+_dictb = np.arange(4, dtype=np.float64).tobytes()
+_idx_huge = bytes([32]) + _uv(((1 << 58) << 1) | 1) + b"\x00" * 8
+_body_a = _defs8 + _idx_huge
+_body_b = _defs8 + bytes([1, 0x03, 0xFF])
+for _evil in [
+    _hdr(2, len(_dictb), 7, [(1, 4), (2, 0)]) + _dictb
+    + _hdr(0, len(_body_a), 5, [(1, 8), (2, 8), (3, 3)]) + _body_a,
+    _hdr(2, 8, 7, [(1, 1 << 61), (2, 0)]) + b"\x00" * 8
+    + _hdr(0, len(_body_b), 5, [(1, 8), (2, 8), (3, 3)]) + _body_b,
+]:
+    _ev = np.frombuffer(_evil, dtype=np.uint8)
+    _vals8 = np.zeros(8, dtype=np.float64)
+    _valid8 = np.zeros(1, dtype=np.uint8)
+    assert native.read_chunk(_ev, 5, 0, 8, 1, 8, _vals8, _valid8) is None
 print("SANITIZED_OK")
 """
 
