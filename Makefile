@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint typecheck analyze sentinel test test-fast trace-demo bench-pushdown bench-decode bench-wire bench-incremental bench-reader clean-native
+.PHONY: lint typecheck analyze sentinel test test-fast trace-demo bench-pushdown bench-decode bench-wire bench-incremental bench-reader bench-forensics clean-native
 
 lint:
 	$(PY) tools/lint.py
@@ -75,6 +75,15 @@ bench-incremental:
 BENCH_READER_ROWS ?= 4000000
 bench-reader:
 	JAX_PLATFORMS=cpu BENCH_MODE=reader BENCH_ROWS=$(BENCH_READER_ROWS) $(PY) bench.py
+
+# failure-forensics capture A/B on the wide-stream shape: the same
+# verification run with .with_forensics() off then on, bit-identity
+# asserted; a completeness constraint failing ~3% of rows makes every
+# batch capture-heavy. Refreshes BENCH_FORENSICS.json (methodology:
+# BENCH.md round 13)
+BENCH_FORENSICS_ROWS ?= 2000000
+bench-forensics:
+	JAX_PLATFORMS=cpu BENCH_MODE=forensics BENCH_ROWS=$(BENCH_FORENSICS_ROWS) $(PY) bench.py
 
 # remove cached native builds (the hash-named .so files): any strays in
 # the package tree from older versions plus the per-user cache dir the

@@ -54,6 +54,16 @@ Knobs (env):
                     dropped before each timed pass; decode-stage busy
                     seconds come from traced warm passes. Refreshes
                     BENCH_READER.json
+                    forensics = failure-forensics capture A/B
+                    (BENCH_FORENSICS.json, ISSUE 12): the same
+                    50-column wide-stream verification run with
+                    .with_forensics() off then on — a completeness
+                    constraint failing on every column-null (~3% of
+                    rows) makes the capture side churn its reservoirs
+                    on every batch, the worst case. Aborts unless
+                    check statuses and metrics are bit-identical;
+                    reports best-of-reps wall per side and the
+                    enabled-side overhead pct
     BENCH_TIMED     timed repetitions, best-of (default 5: shared-vCPU
                      boxes show 20-30% run-to-run noise; best-of-5 reads
                      the machine's actual capability. Compile happens
@@ -1837,6 +1847,137 @@ def pallas_onchip_check() -> str:
         return f"skipped:{type(e).__name__}"
 
 
+def run_forensics_bench(n_rows: int, reps: int) -> None:
+    """BENCH_MODE=forensics: A/B row-level failure-forensics capture
+    (ISSUE 12) on the decode bench's 50-column wide-stream shape. The
+    check mixes a completeness constraint that FAILS on ~3% of rows in
+    a hot column (every batch carries violations, so the capture side
+    pays mask rebuild + reservoir churn on every batch — the worst
+    case) with passing bound/compliance constraints (their capture is
+    pure mask work). Both sides run the identical VerificationSuite;
+    the run aborts unless statuses and metrics are bit-identical
+    (forensics must be provably inert). Wall times are warm-jit
+    best-of-reps, forensics OFF first. Refreshes BENCH_FORENSICS.json
+    (round/config preserved)."""
+    import pyarrow.parquet as pq
+
+    from deequ_tpu.checks.check import Check, CheckLevel
+    from deequ_tpu.data.table import Table
+    from deequ_tpu.verification.suite import VerificationSuite
+
+    path = os.environ.get("BENCH_PARQUET", "/tmp/bench_decode.parquet")
+    t_gen = time.perf_counter()
+    if not (
+        os.path.exists(path) and pq.ParquetFile(path).metadata.num_rows == n_rows
+    ):
+        write_decode_parquet(n_rows, path)
+    gen_s = time.perf_counter() - t_gen
+
+    check = (
+        Check(CheckLevel.ERROR, "forensics bench")
+        # ~3% nulls: FAILS, violations in every batch (capture-heavy)
+        .is_complete("f00")
+        .is_complete("i00")
+        .has_min("f01", lambda v: v >= 0.0)  # passes: bound capture only
+        .has_max("f02", lambda v: v <= 1e6)  # passes
+        .satisfies("f03 >= 0", "f03 nonneg", lambda r: r >= 0.9)  # passes
+    )
+
+    def run_once(forensics: bool):
+        builder = (
+            VerificationSuite()
+            .on_data(Table.scan_parquet(path, batch_rows=1 << 20))
+            .add_check(check)
+        )
+        if forensics:
+            builder = builder.with_forensics()
+        result = builder.run()
+        snapshot = {}
+        for analyzer, metric in result.metrics.items():
+            value = metric.value
+            v = value.get() if value.is_success else type(value.exception).__name__
+            if isinstance(v, float) and v != v:
+                v = "nan"
+            snapshot[repr(analyzer)] = v
+        statuses = tuple(
+            (cr.status.name)
+            for cres in result.check_results.values()
+            for cr in cres.constraint_results
+        )
+        return (statuses, snapshot), result
+
+    warm_key, _ = run_once(False)  # warm-up: jit + imports
+
+    off_s = float("inf")
+    off_key = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        off_key, _ = run_once(False)
+        off_s = min(off_s, time.perf_counter() - t0)
+
+    on_s = float("inf")
+    on_key = None
+    sampled = violations = 0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        on_key, result = run_once(True)
+        on_s = min(on_s, time.perf_counter() - t0)
+        report = result.forensics()
+        sampled = sum(len(c.samples) for c in report.constraints)
+        violations = sum(c.violations_seen for c in report.constraints)
+
+    if not (warm_key == off_key == on_key):
+        raise SystemExit(
+            "forensics A/B: result mismatch between capture-on and "
+            f"capture-off sides\noff: {off_key}\non:  {on_key}"
+        )
+
+    overhead_pct = 100.0 * (on_s - off_s) / off_s if off_s > 0 else 0.0
+    rec = {
+        "metric": "forensics_overhead_pct",
+        "value": round(overhead_pct, 1),
+        "unit": "%",
+        "rows": n_rows,
+        "forensics_ab": {
+            "off_s": round(off_s, 2),
+            "on_s": round(on_s, 2),
+            "overhead_pct": round(overhead_pct, 1),
+            "rows_per_sec_off": round(n_rows / off_s, 1),
+            "rows_per_sec_on": round(n_rows / on_s, 1),
+            "violations_seen": violations,
+            "rows_sampled": sampled,
+            "constraints": 5,
+            "failing_constraints": 2,
+            "bit_identical": True,
+            "reps": reps,
+            "passes": (
+                "one warm-up (off), then best-of-reps warm-jit timed "
+                "passes per side, forensics OFF first"
+            ),
+        },
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "BENCH_FORENSICS.json")
+    try:
+        with open(out_path) as fh:
+            old = json.load(fh)
+        for key in ("round", "config"):
+            if key in old and key not in rec:
+                rec[key] = old[key]
+    except Exception:  # noqa: BLE001 - first write: no fields to carry
+        pass
+    with open(out_path, "w") as fh:
+        json.dump(rec, fh)
+        fh.write("\n")
+    print(
+        f"# bench: forensics A/B off={off_s:.2f}s on={on_s:.2f}s "
+        f"(+{overhead_pct:.1f}%), {violations} violations seen, "
+        f"{sampled} rows sampled; gen={gen_s:.1f}s",
+        file=sys.stderr,
+    )
+    print(json.dumps(rec))
+
+
 def main() -> None:
     platform = os.environ.get("BENCH_PLATFORM")
     if platform:
@@ -1877,6 +2018,11 @@ def main() -> None:
     if mode == "reader":
         # self-contained A/B with its own JSON record and artifact
         run_reader_bench(n_rows)
+        return
+
+    if mode == "forensics":
+        # self-contained A/B with its own JSON record and artifact
+        run_forensics_bench(n_rows, reps)
         return
 
     t_gen = time.perf_counter()

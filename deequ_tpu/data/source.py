@@ -844,6 +844,7 @@ class ParquetSource(DataSource):
         def decode_unit(i: int) -> List[Table]:
             unit = units[i]
             readahead_hit = futures[i].done()
+            heartbeat.current().note_readahead(bool(readahead_hit))
             raw = futures[i].result()
             window_advance()
             with _spans.attached(tracer, parent):

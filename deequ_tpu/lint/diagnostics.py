@@ -52,6 +52,8 @@ CODES = {
     "DQ313": "column falls off decode-to-wire fusion",
     "DQ314": "state-cache entry unusable; partition falls back to rescan",
     "DQ315": "column-chunk falls off the native parquet reader",
+    "DQ316": "constraint falls off row-level failure forensics",
+    "DQ317": "forensics audit-trail entry unusable; forensics unavailable",
 }
 
 

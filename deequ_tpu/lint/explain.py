@@ -476,9 +476,27 @@ def render_explain(
 class ExplainResult:
     cost: PlanCost
     diagnostics: List[Diagnostic] = field(default_factory=list)
+    # failure-forensics capability prediction (observe/forensics
+    # classification, computed statically from the checks): constraint
+    # repr -> row-level family for capable constraints, and
+    # (constraint repr, reason) for the DQ316 fall-offs
+    forensics_capable: List[Tuple[str, str]] = field(default_factory=list)
+    forensics_falloffs: List[Tuple[str, str]] = field(default_factory=list)
 
     def render(self) -> str:
-        return render_explain(self.cost, self.diagnostics)
+        text = render_explain(self.cost, self.diagnostics)
+        if self.forensics_capable or self.forensics_falloffs:
+            lines = [
+                "failure forensics (with_forensics() / "
+                "DEEQU_TPU_FORENSICS=1): "
+                f"{len(self.forensics_capable)} of "
+                f"{len(self.forensics_capable) + len(self.forensics_falloffs)}"
+                " constraint(s) capture violating rows"
+            ]
+            for rep, kind in self.forensics_capable:
+                lines.append(f"  + {rep}: {kind}")
+            text = "\n".join([text] + lines)
+        return text
 
     def __str__(self) -> str:
         return self.render()
@@ -580,8 +598,42 @@ def explain_plan(
         decode_types=decode_types,
         partitions=partitions,
     )
+    diagnostics = cost_diagnostics(cost, plan, schema)
+    # DQ316 — failure-forensics capability, predicted from the SAME
+    # static classification the capture itself uses: constraints whose
+    # violating rows cannot be identified per batch fall off with the
+    # classifier's reason, so an operator knows before running which
+    # failures will come back with row evidence and which won't
+    capable: List[Tuple[str, str]] = []
+    falloffs: List[Tuple[str, str]] = []
+    if checks:
+        try:
+            from deequ_tpu.observe.forensics import classify_constraints
+
+            for constraint, _inner, kind, reason in classify_constraints(
+                checks
+            ):
+                if kind is not None:
+                    capable.append((repr(constraint), kind))
+                else:
+                    falloffs.append((repr(constraint), reason))
+                    diagnostics.append(
+                        Diagnostic(
+                            "DQ316",
+                            Severity.WARNING,
+                            f"constraint {constraint!r} falls off row-level "
+                            f"failure forensics ({reason}): a FAILURE "
+                            "reports the metric value only, with no "
+                            "sampled violating rows",
+                        )
+                    )
+        except Exception:  # noqa: BLE001 — prediction is advisory
+            capable, falloffs = [], []
     return ExplainResult(
-        cost=cost, diagnostics=cost_diagnostics(cost, plan, schema)
+        cost=cost,
+        diagnostics=diagnostics,
+        forensics_capable=capable,
+        forensics_falloffs=falloffs,
     )
 
 

@@ -61,6 +61,14 @@ from deequ_tpu.observe.runtrace import (
     traced_run,
 )
 from deequ_tpu.observe import heartbeat
+from deequ_tpu.observe.forensics import (
+    ConstraintForensics,
+    ForensicsCapture,
+    ForensicsReport,
+    ViolationSample,
+    classify_constraints,
+    render_forensics,
+)
 from deequ_tpu.observe.heartbeat import scan_heartbeat
 from deequ_tpu.observe.telemetry import (
     engine_metric_record,
@@ -91,12 +99,18 @@ __all__ = [
     "render_report",
     "ENV_KNOB",
     "ENV_OUT",
+    "ConstraintForensics",
+    "ForensicsCapture",
+    "ForensicsReport",
     "RunTrace",
+    "ViolationSample",
+    "classify_constraints",
     "default_trace_path",
     "dispatch_signature",
     "engine_metric_record",
     "env_enabled",
     "heartbeat",
+    "render_forensics",
     "latest_results",
     "observed_family_groups",
     "openmetrics_text",

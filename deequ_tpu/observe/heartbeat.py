@@ -114,6 +114,9 @@ class _NoopProgress:
     def timed(self, stage: str) -> _NoopTimer:
         return _NOOP_TIMER
 
+    def note_readahead(self, hit: bool) -> None:
+        pass
+
     def snapshot(self, final: bool = False) -> Optional[Dict[str, Any]]:
         return None
 
@@ -209,6 +212,8 @@ class ScanProgress:
         self._last_t = self._t0
         self._stage_lock = threading.Lock()
         self._stage_busy: Dict[str, float] = {}
+        self._readahead_hits = 0
+        self._readahead_misses = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -224,6 +229,18 @@ class ScanProgress:
     def timed(self, stage: str) -> _StageTimer:
         return _StageTimer(self, stage)
 
+    def note_readahead(self, hit: bool) -> None:
+        """Read-ahead window accounting from the native parquet reader's
+        decode side: `hit` means the prefetch future was already done
+        when the decoder asked for it. A miss is a decode stall waiting
+        on the window — time the stage timers misattribute to the
+        *consumer's* stage, so it must be counted, not timed."""
+        with self._stage_lock:
+            if hit:
+                self._readahead_hits += 1
+            else:
+                self._readahead_misses += 1
+
     # -- snapshots -----------------------------------------------------------
 
     def snapshot(self, final: bool = False) -> Dict[str, Any]:
@@ -236,6 +253,7 @@ class ScanProgress:
         avg = rows / wall
         with self._stage_lock:
             stages = dict(self._stage_busy)
+            ra_hits, ra_misses = self._readahead_hits, self._readahead_misses
 
         eta: Optional[float] = None
         progress_frac: Optional[float] = None
@@ -267,6 +285,12 @@ class ScanProgress:
         if stages:
             snap["bottleneck"] = max(stages, key=lambda s: stages[s])
             snap["occupancy"] = {s: round(b / wall, 4) for s, b in sorted(stages.items())}
+        if ra_hits or ra_misses:
+            snap["readahead"] = {"hits": ra_hits, "misses": ra_misses}
+            if ra_misses > ra_hits:
+                # a starved read-ahead window stalls the decoder inside
+                # its own stage timer; name the true bottleneck
+                snap["bottleneck"] = "read"
         return snap
 
     def _emit(self, snap: Dict[str, Any]) -> None:

@@ -70,12 +70,19 @@ def pipeline_occupancy(roots: Roots) -> List[Dict[str, Any]]:
         {stage, wall_s, busy_s, stall_s, occupancy, items}
 
     sorted by busy_s descending, so row 0 is the pipeline's bottleneck
-    stage (the one the other stages stall on). Pure function of the
-    spans; the same rows back `render_report`'s pipeline section and
-    the bench artifacts' occupancy breakdown. Empty when the run never
-    engaged the pipeline (serial fallback, in-memory tables)."""
+    stage (the one the other stages stall on). The native parquet
+    reader's read-ahead window (data/source.py `page_read` spans +
+    `readahead_hit` on `page_decode`) folds in as a synthetic "read"
+    row: when prefetch misses dominate, the decoder's blocked waits
+    hide inside another stage's time, so the read row is promoted to
+    the bottleneck slot instead of the stall showing up as idle decode.
+    Pure function of the spans; the same rows back `render_report`'s
+    pipeline section and the bench artifacts' occupancy breakdown.
+    Empty when the run never engaged the pipeline (serial fallback,
+    in-memory tables)."""
     rows: Dict[str, Dict[str, Any]] = {}
     order: List[str] = []
+    readahead = {"spans": 0, "busy_s": 0.0, "hits": 0, "misses": 0}
 
     def visit(span: Span) -> None:
         if span.name == PIPE_STAGE_SPAN:
@@ -95,6 +102,12 @@ def pipeline_occupancy(roots: Roots) -> List[Dict[str, Any]]:
                 row["busy_s"] += child.duration_s
                 if not child.attrs.get("eos"):
                     row["items"] += 1
+        elif span.name == "page_read":
+            readahead["spans"] += 1
+            readahead["busy_s"] += span.duration_s
+        elif span.name == "page_decode" and "readahead_hit" in span.attrs:
+            key = "hits" if span.attrs.get("readahead_hit") else "misses"
+            readahead[key] += 1
         for child in span.children:
             visit(child)
 
@@ -109,6 +122,27 @@ def pipeline_occupancy(roots: Roots) -> List[Dict[str, Any]]:
         )
         out.append(row)
     out.sort(key=lambda r: -r["busy_s"])
+    if out and readahead["spans"]:
+        # the fetch thread has no pipe_stage span of its own; its wall
+        # is the pipeline's wall (the widest stage)
+        wall = max(r["wall_s"] for r in out)
+        busy = min(readahead["busy_s"], wall)
+        row = {
+            "stage": "read",
+            "wall_s": wall,
+            "busy_s": busy,
+            "items": readahead["spans"],
+            "stall_s": max(wall - busy, 0.0),
+            "occupancy": busy / wall if wall > 0 else 0.0,
+            "readahead_hits": readahead["hits"],
+            "readahead_misses": readahead["misses"],
+        }
+        if readahead["misses"] > readahead["hits"]:
+            # starved window: consumers block on fetch futures, so the
+            # read stage is the true bottleneck
+            out.insert(0, row)
+        else:
+            out.append(row)
     return out
 
 
@@ -185,9 +219,13 @@ def render_report(
     roots: Roots,
     counters: Optional[Dict[str, int]] = None,
     max_depth: int = 8,
+    forensics: Optional[Any] = None,
 ) -> str:
     """The run report: headline counters, the (aggregated) span tree,
-    and the per-phase self-time line."""
+    and the per-phase self-time line. Pass a ForensicsReport (e.g.
+    `result.forensics()`) as `forensics` to append the failure-forensics
+    section — sampled violating rows and scan provenance per failed
+    constraint."""
     root_list = _roots_of(roots)
     if not root_list:
         return "deequ_tpu run report — (no spans recorded)"
@@ -218,11 +256,17 @@ def render_report(
         lines.append("pipeline occupancy (busy/wall per stage):")
         for i, row in enumerate(occupancy):
             marker = "  <- bottleneck" if i == 0 else ""
+            ra = ""
+            if "readahead_hits" in row:
+                ra = (
+                    f"  readahead {row['readahead_hits']}h"
+                    f"/{row['readahead_misses']}m"
+                )
             lines.append(
                 f"  {row['stage']:<8} {row['occupancy'] * 100:5.1f}%"
                 f"  busy {row['busy_s']:.3f}s"
                 f"  stall {row['stall_s']:.3f}s"
-                f"  items {row['items']}{marker}"
+                f"  items {row['items']}{ra}{marker}"
             )
     phases = phase_seconds(root_list)
     phase_text = " | ".join(
@@ -231,4 +275,9 @@ def render_report(
         if phases[name] > 0 or name in PHASES
     )
     lines.append(f"phases (self-time): {phase_text}")
+    if forensics is not None:
+        # duck-typed (ForensicsReport.render via __str__) so this module
+        # never imports observe/forensics — row VALUES belong to reports
+        # the operator asks for, never to telemetry records
+        lines.append(str(forensics))
     return "\n".join(lines)

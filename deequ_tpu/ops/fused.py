@@ -1797,6 +1797,7 @@ class FusedScanPass:
         analyzers: Sequence[ScanShareableAnalyzer],
         batch_size: Optional[int] = None,
         state_cache=None,
+        forensics=None,
     ):
         self.analyzers = list(analyzers)
         # None = unset: the pass may widen the default for pure-host
@@ -1809,6 +1810,10 @@ class FusedScanPass:
         # repository/states.StateCacheContext (or None): lets a
         # partitioned run swap a partition's scan for a state load
         self._state_cache = state_cache
+        # observe/forensics.ForensicsCapture (or None, the default):
+        # row-level violation capture + provenance notes. The off path
+        # is one falsy check per batch — provably inert
+        self._forensics = forensics
 
     def run(self, table: Table) -> List[AnalyzerRunResult]:
         if getattr(table, "partitions", None) is not None:
@@ -1853,6 +1858,10 @@ class FusedScanPass:
                 # constant-mask where's filter columns drop out of decode
                 table = apply_prune_plan(table, prune, specs)
             table = prune_table_columns(table, specs)
+            if self._forensics is not None:
+                # coordinate map + prune provenance come from the PRUNED
+                # source: scan offsets then map to surviving row groups
+                self._forensics.note_table(table)
             # decode routing comes last: it classifies exactly the
             # columns that survived pruning (with_columns returns a new
             # source, so the fast set must attach to the final view)
@@ -1861,6 +1870,8 @@ class FusedScanPass:
             )
             if decode_plan is not None:
                 table = apply_decode_plan(table, decode_plan)
+                if self._forensics is not None:
+                    self._forensics.note_decode_plan(decode_plan)
             merge_analyzers = [self.analyzers[i] for i in merge_idx]
             assisted = [self.analyzers[i] for i in assisted_idx]
             host_members = [(i, self.analyzers[i]) for i in host_idx]
@@ -1920,7 +1931,8 @@ class FusedScanPass:
             else None
         )
         signature = None
-        if cache is not None:
+        cap = self._forensics
+        if cache is not None or cap is not None:
             from deequ_tpu.repository.states import plan_signature
 
             batch_rows = getattr(source, "batch_rows", None)
@@ -1933,6 +1945,8 @@ class FusedScanPass:
                 ),
                 batch_rows=int(batch_rows) if batch_rows else None,
             )
+        if cap is not None:
+            cap.note_plan_signature(signature)
         merged: Optional[List[AnalyzerRunResult]] = None
         cached_n = 0
         scanned_n = 0
@@ -1955,13 +1969,22 @@ class FusedScanPass:
                         for a, s in zip(self.analyzers, states)
                     ]
                     cached_n += 1
+                    if cap is not None:
+                        cap.note_partition(part.name, part.fingerprint, "cache")
             if results is None:
                 sub = FusedScanPass(
                     self.analyzers,
                     self.batch_size if self._batch_size_explicit else None,
+                    forensics=(
+                        cap.enter_partition(part.name, part.fingerprint)
+                        if cap is not None
+                        else None
+                    ),
                 )
                 results = sub.run(part.source())
                 scanned_n += 1
+                if cap is not None:
+                    cap.note_partition(part.name, part.fingerprint, "scan")
                 if cache is not None and all(r.error is None for r in results):
                     with observe.span(
                         "state_cache", cat="cache", op="save",
@@ -2181,6 +2204,12 @@ class FusedScanPass:
                             host_errors, batch=batch, streaming=streaming,
                             family_memo=family_memo,
                         )
+                    if self._forensics is not None:
+                        with observe.span(
+                            "forensics_capture", cat="forensics",
+                            rows=batch.num_rows,
+                        ):
+                            self._forensics.capture_batch(batch, scanned_rows)
                     scanned_rows += batch.num_rows
                     scanned_batches += 1
                     progress.advance(batch.num_rows)
@@ -2371,6 +2400,14 @@ class FusedScanPass:
                                 batch=batch, streaming=True,
                                 family_memo=family_memo, precomputed=True,
                             )
+                        if self._forensics is not None:
+                            with observe.span(
+                                "forensics_capture", cat="forensics",
+                                rows=batch.num_rows,
+                            ):
+                                self._forensics.capture_batch(
+                                    batch, scanned_rows
+                                )
                     scanned_rows += batch.num_rows
                     scanned_batches += 1
                     progress.advance(batch.num_rows)

@@ -282,6 +282,24 @@ def native_reader_enabled() -> bool:
     return os.environ.get("DEEQU_TPU_NATIVE_READER", "") not in ("0", "off")
 
 
+def forensics_enabled() -> bool:
+    """Whether verification runs capture failure forensics by default
+    (observe/forensics.py): a bounded deterministic sample of violating
+    rows per row-level-capable constraint, plus the run's provenance
+    record, persisted as an audit trail.
+
+    Unlike every other knob this one defaults OFF — capture does real
+    per-batch work, so it must be asked for: `DEEQU_TPU_FORENSICS=1`
+    (or `on`/`true`), or `with_forensics()` on the run builder. When
+    off the fused pass carries a None capture and the per-batch hook is
+    one falsy check — the forensics differential suite proves the off
+    path bit-identical and the overhead suite bounds it under the same
+    budget as tracing."""
+    import os
+
+    return os.environ.get("DEEQU_TPU_FORENSICS", "") in ("1", "on", "true")
+
+
 def wire_pad_size(n: int, batch_size: int) -> int:
     """The fused pass's padded row length for an n-row batch (mirror of
     ops/fused.py:_pad_size, which delegates here): power of two, min 8,

@@ -198,6 +198,14 @@ def serialize_analyzer(analyzer: Analyzer) -> Dict[str, Any]:
             "metric": analyzer.metric,
             "instance": analyzer.instance,
         }
+    from deequ_tpu.repository.audit import AuditRecord
+
+    if isinstance(analyzer, AuditRecord):
+        return {
+            ANALYZER_NAME_FIELD: "ForensicsAudit",
+            "payload": analyzer.payload,
+            "instance": analyzer.instance,
+        }
     raise ValueError(f"Unable to serialize analyzer {analyzer!r}.")
 
 
@@ -254,6 +262,12 @@ def deserialize_analyzer(data: Dict[str, Any]) -> Analyzer:
         from deequ_tpu.repository.engine import EngineMetric
 
         return EngineMetric(data["metric"], data.get("instance", "engine"))
+    if name == "ForensicsAudit":
+        from deequ_tpu.repository.audit import AuditRecord
+
+        return AuditRecord(
+            data.get("payload", ""), data.get("instance", "forensics")
+        )
     raise ValueError(f"Unable to deserialize analyzer {name}.")
 
 

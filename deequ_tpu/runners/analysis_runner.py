@@ -50,6 +50,7 @@ class AnalysisRunner:
         tracing=None,
         state_repository=None,
         dataset_name: str = "default",
+        forensics=None,
     ) -> AnalyzerContext:
         if not analyzers:
             return AnalyzerContext.empty()
@@ -76,6 +77,7 @@ class AnalysisRunner:
                 validation,
                 state_repository,
                 dataset_name,
+                forensics,
             )
         if run:
             context.run_trace = run.trace
@@ -97,6 +99,7 @@ class AnalysisRunner:
         validation: Optional[str] = None,
         state_repository=None,
         dataset_name: str = "default",
+        forensics=None,
     ) -> AnalyzerContext:
         # partition-state cache (repository/states.py): only partitioned
         # sources have a per-partition fold to cache; the context rides
@@ -180,7 +183,7 @@ class AnalysisRunner:
         # 4. fused scan pass (reference: AnalysisRunner.scala:279-326)
         scanning_results = AnalysisRunner._run_scanning_analyzers(
             data, scanning, aggregate_with, save_states_with, mesh,
-            state_cache,
+            state_cache, forensics,
         )
 
         # 5. one frequency pass per grouping-column-set
@@ -310,6 +313,7 @@ class AnalysisRunner:
         save_states_with: Optional["StatePersister"],
         mesh=None,
         state_cache=None,
+        forensics=None,
     ) -> AnalyzerContext:
         if not analyzers:
             return AnalyzerContext.empty()
@@ -322,13 +326,14 @@ class AnalysisRunner:
             if mesh is not None:
                 # the distributed pass shards batches across devices —
                 # there is no per-partition fold to cache, so the mesh
-                # path always scans (documented fallback)
+                # path always scans (documented fallback); forensics
+                # capture likewise degrades to provenance-only there
                 from deequ_tpu.parallel.distributed import DistributedScanPass
 
                 results = DistributedScanPass(shareable, mesh=mesh).run(data)
             else:
                 results = FusedScanPass(
-                    shareable, state_cache=state_cache
+                    shareable, state_cache=state_cache, forensics=forensics
                 ).run(data)
             for result in results:
                 analyzer = result.analyzer

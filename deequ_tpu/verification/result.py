@@ -33,6 +33,17 @@ class VerificationResult:
     # static cost prediction (lint/cost.PlanCost) from the validation
     # pass; None when validation is off
     plan_cost: object = None
+    # failure forensics (observe/forensics.ForensicsReport): sampled
+    # violating rows + metric provenance when capture was enabled via
+    # with_forensics(...) or DEEQU_TPU_FORENSICS, else None
+    forensics_report: object = None
+
+    def forensics(self):
+        """The run's ForensicsReport — per-constraint sampled violating
+        rows with (partition, row group, row index, value) coordinates
+        plus plan/partition provenance — or None when forensics capture
+        was off (the default)."""
+        return self.forensics_report
 
     # -- metric exporters (reference: VerificationResult.scala:40-72) --------
 

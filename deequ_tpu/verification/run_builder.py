@@ -48,6 +48,8 @@ class VerificationRunBuilder:
         self._dataset_name: str = "default"
         self._validation: Optional[str] = None
         self._tracing = None
+        self._forensics: Optional[bool] = None
+        self._forensics_max_samples: int = 10
         self._save_check_results_json_path: Optional[str] = None
         self._save_success_metrics_json_path: Optional[str] = None
         self._overwrite_output_files = False
@@ -86,6 +88,23 @@ class VerificationRunBuilder:
         Perfetto); False forces tracing off regardless of the
         DEEQU_TPU_TRACE env knob."""
         self._tracing = trace
+        return self
+
+    def with_forensics(
+        self, enabled: bool = True, max_samples: int = 10
+    ) -> "VerificationRunBuilder":
+        """Failure forensics (deequ_tpu.observe.forensics): capture a
+        bounded deterministic sample of violating rows — with
+        (partition, row group, row index, offending values)
+        coordinates — for every row-level-capable constraint, plus a
+        provenance record per run (plan signature, scanned-vs-cached
+        partitions, row groups pruned, decode routing). Attached as
+        `result.forensics()`; persisted as an audit trail when a
+        metrics repository and save key are set. Off by default (also
+        reachable via DEEQU_TPU_FORENSICS=1); metrics and check
+        outcomes are bit-identical either way."""
+        self._forensics = bool(enabled)
+        self._forensics_max_samples = int(max_samples)
         return self
 
     def add_check(self, check: Check) -> "VerificationRunBuilder":
@@ -204,6 +223,8 @@ class VerificationRunBuilder:
             tracing=self._tracing,
             state_repository=self._state_repository,
             dataset_name=self._dataset_name,
+            forensics=self._forensics,
+            forensics_max_samples=self._forensics_max_samples,
         )
         # JSON file outputs (reference: VerificationSuite.scala:146-172)
         from deequ_tpu.core.fileio import write_text_output

@@ -11,6 +11,7 @@ from typing import TYPE_CHECKING, Dict, List, Optional, Sequence
 from deequ_tpu import observe
 from deequ_tpu.analyzers.base import Analyzer
 from deequ_tpu.checks.check import Check, CheckResult, CheckStatus
+from deequ_tpu.ops.runtime import forensics_enabled as runtime_forensics_enabled
 from deequ_tpu.runners.analysis_runner import AnalysisRunner
 from deequ_tpu.runners.context import AnalyzerContext
 from deequ_tpu.verification.result import VerificationResult
@@ -54,6 +55,8 @@ class VerificationSuite:
         tracing=None,
         state_repository=None,
         dataset_name: str = "default",
+        forensics: Optional[bool] = None,
+        forensics_max_samples: int = 10,
     ) -> VerificationResult:
         """reference: VerificationSuite.scala:107-144.
 
@@ -71,6 +74,14 @@ class VerificationSuite:
         with a `StateRepository` and a partitioned source, unchanged
         partitions load their folded analyzer states from the cache
         instead of rescanning (see runners.AnalysisRunner).
+
+        `forensics` — failure forensics (deequ_tpu.observe.forensics):
+        True captures a bounded deterministic sample of violating rows
+        per row-level-capable constraint plus metric provenance,
+        attached as `result.forensics()` and persisted as an audit
+        trail when a repository + save key are set; False forces off;
+        None (default) defers to the DEEQU_TPU_FORENSICS env knob.
+        Metrics are bit-identical either way.
         """
         with observe.traced_run(
             "verification_suite", enable=tracing, checks=len(checks)
@@ -78,6 +89,22 @@ class VerificationSuite:
             analyzers: List[Analyzer] = list(required_analyzers)
             for check in checks:
                 analyzers.extend(check.required_analyzers())
+
+            capture = None
+            enable_forensics = (
+                forensics
+                if forensics is not None
+                else runtime_forensics_enabled()
+            )
+            if enable_forensics and mesh is None:
+                # mesh runs shard batches across devices: no ordered
+                # per-batch host fold to hook, so capture degrades to off
+                # (documented fallback, mirrors the state-cache rule)
+                from deequ_tpu.observe.forensics import ForensicsCapture
+
+                capture = ForensicsCapture(
+                    checks, max_samples=forensics_max_samples
+                )
 
             with observe.span("plan_validate", cat="plan"):
                 validation_diagnostics, plan_cost = (
@@ -113,6 +140,7 @@ class VerificationSuite:
                 validation="off",
                 state_repository=state_repository,
                 dataset_name=dataset_name,
+                forensics=capture,
             )
 
             verification_result = VerificationSuite.evaluate(
@@ -121,6 +149,23 @@ class VerificationSuite:
             verification_result.validation_warnings = validation_diagnostics
             verification_result.plan_cost = plan_cost
 
+            save_context = analysis_results
+            if capture is not None:
+                report = capture.finalize(verification_result.check_results)
+                verification_result.forensics_report = report
+                if (
+                    metrics_repository is not None
+                    and save_or_append_results_with_key is not None
+                ):
+                    # the audit trail persists through the SAME repository
+                    # save as the metrics it explains (repository/audit.py)
+                    from deequ_tpu.repository.audit import audit_entry_for
+
+                    record, metric = audit_entry_for(report)
+                    save_context = analysis_results + AnalyzerContext(
+                        {record: metric}
+                    )
+
             if (
                 metrics_repository is not None
                 and save_or_append_results_with_key is not None
@@ -128,7 +173,7 @@ class VerificationSuite:
                 AnalysisRunner._save_or_append(
                     metrics_repository,
                     save_or_append_results_with_key,
-                    analysis_results,
+                    save_context,
                 )
         if run:
             verification_result.run_trace = run.trace

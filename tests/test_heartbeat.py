@@ -263,3 +263,40 @@ class TestHeartbeatUnit:
         progress.finish()  # must not raise
         with open(out, encoding="utf-8") as fh:
             assert json.loads(fh.readline())["rows"] == 5
+
+
+class TestReadaheadAttribution:
+    """ISSUE 12 satellite: read-ahead hits/misses fold into the
+    heartbeat snapshot, and a miss-starved window renames the
+    bottleneck to "read" (the blocked future waits otherwise hide
+    inside the consumer stage's timer)."""
+
+    def test_misses_promote_read_bottleneck(self):
+        progress = heartbeat.ScanProgress(1000.0, name="unit")
+        with progress.timed("fold"):
+            time.sleep(0.01)
+        for hit in (True, False, False):
+            progress.note_readahead(hit)
+        snap = progress.snapshot()
+        assert snap["readahead"] == {"hits": 1, "misses": 2}
+        assert snap["bottleneck"] == "read"
+        progress.finish()
+
+    def test_hits_keep_stage_bottleneck(self):
+        progress = heartbeat.ScanProgress(1000.0, name="unit")
+        with progress.timed("decode"):
+            time.sleep(0.01)
+        for hit in (True, True, False):
+            progress.note_readahead(hit)
+        snap = progress.snapshot()
+        assert snap["readahead"] == {"hits": 2, "misses": 1}
+        assert snap["bottleneck"] == "decode"
+        progress.finish()
+
+    def test_no_readahead_no_snapshot_key(self):
+        progress = heartbeat.ScanProgress(1000.0, name="unit")
+        assert "readahead" not in progress.snapshot()
+        progress.finish()
+
+    def test_noop_progress_accepts_note_readahead(self):
+        heartbeat.NOOP_PROGRESS.note_readahead(True)  # must not raise

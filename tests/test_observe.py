@@ -482,3 +482,63 @@ class TestTracingIsInert:
         assert plain.keys() == traced.keys() == off.keys()
         for key in plain:
             assert plain[key] == traced[key] == off[key], key  # bit-identical
+
+
+# -- read-ahead fold into pipeline occupancy (ISSUE 12 satellite) -------------
+
+
+class TestReadaheadOccupancy:
+    """The native reader's read-ahead window (`page_read` spans +
+    `readahead_hit` attrs on `page_decode`) folds into
+    `pipeline_occupancy` as a synthetic "read" row, promoted to the
+    bottleneck slot when prefetch misses dominate."""
+
+    def _forest(self, hits, misses):
+        root = _mk_span("analysis_run", "run", 0.0, 1.0)
+        decode = _mk_span("pipe_stage", "pipeline", 0.0, 1.0, stage="decode")
+        decode.children.append(_mk_span("pipe_item", "pipeline", 0.0, 0.4))
+        fold = _mk_span("pipe_stage", "pipeline", 0.0, 1.0, stage="fold")
+        fold.children.append(_mk_span("pipe_item", "pipeline", 0.0, 0.9))
+        root.children += [decode, fold]
+        root.children += [
+            _mk_span("page_read", "io", 0.0, 0.3),
+            _mk_span("page_read", "io", 0.3, 0.5),
+        ]
+        for i in range(hits):
+            root.children.append(
+                _mk_span("page_decode", "io", 0.5, 0.6, readahead_hit=True)
+            )
+        for i in range(misses):
+            root.children.append(
+                _mk_span("page_decode", "io", 0.6, 0.7, readahead_hit=False)
+            )
+        return root
+
+    def test_miss_dominated_promotes_read_to_bottleneck(self):
+        rows = observe.pipeline_occupancy([self._forest(hits=1, misses=3)])
+        assert rows[0]["stage"] == "read"
+        assert rows[0]["readahead_hits"] == 1
+        assert rows[0]["readahead_misses"] == 3
+        assert rows[0]["items"] == 2  # two page_read fetches
+        # fetch wall is the widest stage's wall; busy is the fetch time
+        assert rows[0]["wall_s"] == pytest.approx(1.0)
+        assert rows[0]["busy_s"] == pytest.approx(0.5)
+        assert rows[0]["occupancy"] == pytest.approx(0.5)
+
+    def test_hit_dominated_read_row_trails(self):
+        rows = observe.pipeline_occupancy([self._forest(hits=3, misses=1)])
+        assert rows[0]["stage"] == "fold"  # busiest pipe stage leads
+        assert rows[-1]["stage"] == "read"
+        assert rows[-1]["readahead_hits"] == 3
+
+    def test_no_pipe_stages_means_no_occupancy_rows(self):
+        """Serial native-reader runs record page_read spans but no pipe
+        stages; the occupancy table stays empty (its golden contract)."""
+        root = _mk_span("analysis_run", "run", 0.0, 1.0)
+        root.children.append(_mk_span("page_read", "io", 0.0, 0.3))
+        assert observe.pipeline_occupancy([root]) == []
+
+    def test_render_report_carries_readahead_suffix(self):
+        text = observe.render_report([self._forest(hits=1, misses=3)])
+        assert "readahead 1h/3m" in text
+        assert "read" in text.split("bottleneck")[0]  # promoted row

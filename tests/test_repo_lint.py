@@ -573,3 +573,83 @@ def test_reader_rule_covers_the_dispatch_module():
     assert f"deequ_tpu{sep}data{sep}native_reader.py" in rels
     for rel in rels:
         assert os.path.exists(os.path.join(REPO, rel)), rel
+
+
+# -- FORENSICS: no row samples on telemetry surfaces -------------------------
+
+
+def test_forensics_checker_flags_module_import():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def snapshot():\n"
+        "    from deequ_tpu.observe.forensics import ForensicsReport\n"
+        "    return ForensicsReport\n"
+    )
+    try:
+        findings = lint.check_forensics_leak(path)
+    finally:
+        os.unlink(path)
+    assert findings
+    assert any("FORENSICS" in f for f in findings)
+
+
+def test_forensics_checker_flags_plain_import():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import deequ_tpu.observe.forensics as fo\n"
+        "def record():\n"
+        "    return fo\n"
+    )
+    try:
+        findings = lint.check_forensics_leak(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+    assert "FORENSICS" in findings[0]
+
+
+def test_forensics_checker_flags_sample_identifiers():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def emit(report):\n"
+        "    # even without the import, touching the sample types leaks\n"
+        "    return [s.values for s in report.constraints[0].samples\n"
+        "            if isinstance(s, ViolationSample)]\n"
+    )
+    try:
+        findings = lint.check_forensics_leak(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+    assert "ViolationSample" in findings[0]
+
+
+def test_forensics_checker_allows_ordinary_telemetry_code():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import json\n"
+        "def engine_metric_record(name, value):\n"
+        "    return json.dumps({'series': f'engine.{name}', 'value': value})\n"
+    )
+    try:
+        findings = lint.check_forensics_leak(path)
+    finally:
+        os.unlink(path)
+    assert findings == []
+
+
+def test_forensics_rule_covers_the_telemetry_surfaces():
+    lint = _lint_module()
+    sep = os.sep
+    rels = set(lint.FORENSICS_FILES)
+    assert f"deequ_tpu{sep}observe{sep}telemetry.py" in rels
+    assert f"deequ_tpu{sep}observe{sep}heartbeat.py" in rels
+    assert f"deequ_tpu{sep}repository{sep}engine.py" in rels
+    for rel in rels:
+        assert os.path.exists(os.path.join(REPO, rel)), rel
+
+
+def test_serde_rule_covers_the_audit_envelope():
+    lint = _lint_module()
+    sep = os.sep
+    assert f"deequ_tpu{sep}repository{sep}audit.py" in set(lint.SERDE_FILES)

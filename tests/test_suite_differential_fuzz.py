@@ -989,3 +989,57 @@ def test_state_cache_drift_pins_zero_and_traces(monkeypatch, tmp_path):
     rec = engine_metric_record(warm.run_trace, warm.plan_cost)
     assert rec["engine.state_cache_hit_ratio"] == 1.0
     assert rec["engine.drift.partitions_cached"] == 0.0
+
+
+# -- forensics on/off differential (ISSUE 12) --------------------------------
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_forensics_on_off_bit_identical(seed, monkeypatch, tmp_path):
+    """with_forensics() must be provably inert: exact snapshot equality
+    (metrics, check statuses, sketches included) with row-level capture
+    on vs off — on both placements, with the streaming pipeline on and
+    off, and through a state-cache cold fill and all-hit warm run
+    (cached partitions reduce forensics to provenance, never change
+    results). Capture reads the decoded batch through its own masks and
+    never touches the fold inputs, so nothing may diverge by one bit."""
+    from deequ_tpu.data.table import Table as TableCls
+    from deequ_tpu.repository.states import FileSystemStateRepository
+
+    rng = np.random.default_rng(19_000 + seed)
+    checks = [random_check(rng) for _ in range(int(rng.integers(1, 3)))]
+    data_dir = tmp_path / "dataset"
+    data_dir.mkdir()
+    for i in range(3):
+        _write_partition(random_table(rng), str(data_dir / f"part-{i}.parquet"))
+    repo = FileSystemStateRepository(str(tmp_path / "cache"))
+
+    def run(placement, pipeline, forensics, cached=False):
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+        monkeypatch.setenv("DEEQU_TPU_PIPELINE", pipeline)
+        monkeypatch.setenv("DEEQU_TPU_STATE_CACHE", "1" if cached else "0")
+        data = TableCls.scan_parquet_dataset(str(data_dir))
+        builder = VerificationSuite().on_data(data)
+        for check in checks:
+            builder = builder.add_check(check)
+        if cached:
+            builder = builder.with_state_repository(repo, "forensics-fuzz")
+        if forensics:
+            builder = builder.with_forensics()
+        result = builder.with_engine("single").run()
+        # the report rides the result exactly when capture was on
+        assert (result.forensics() is not None) == forensics
+        return suite_snapshot(result)
+
+    for placement in ("host", "device"):
+        for pipeline in ("0", "1"):
+            off = run(placement, pipeline, False)
+            on = run(placement, pipeline, True)
+            assert off == on, (seed, placement, pipeline)
+
+    baseline = run("host", "1", False)
+    # cold: capture rides the scans that fill the cache
+    assert run("host", "1", True, cached=True) == baseline, (seed, "cold")
+    # warm: every partition merges from cache; capture sees no batches
+    assert run("host", "1", True, cached=True) == baseline, (seed, "warm-on")
+    assert run("host", "1", False, cached=True) == baseline, (seed, "warm-off")

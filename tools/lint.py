@@ -56,12 +56,23 @@ Checks:
              already holds the pyarrow reader.
   SERDE    — no `pickle` (import or call) in the state serde paths
              (deequ_tpu/repository/states.py,
+             deequ_tpu/repository/audit.py,
              deequ_tpu/analyzers/state_provider.py): persisted analyzer
              states are exact-width binary formats that round-trip
              bit-exactly and decode safely; pickle is neither (arbitrary
              code execution on load, no cross-version byte stability),
              so one import silently voids both the bit-identity and the
              corrupt-falls-back-to-rescan contracts.
+  FORENSICS — telemetry surfaces (deequ_tpu/observe/telemetry.py,
+             deequ_tpu/observe/heartbeat.py,
+             deequ_tpu/repository/engine.py) must not import
+             deequ_tpu.observe.forensics or touch its row-sample types
+             (ViolationSample, ConstraintForensics, ForensicsReport,
+             render_forensics): sampled row VALUES are data, and the
+             `engine.*` series, OpenMetrics text, and heartbeat
+             snapshots are operational metadata that leaves the trust
+             boundary (dashboards, scrapes, log shippers). Row evidence
+             belongs to the audit trail an operator explicitly loads.
   F401*    — unused imports (fallback when ruff is unavailable).
   E722*    — bare `except:` (fallback when ruff is unavailable).
 
@@ -127,8 +138,24 @@ READER_FORBIDDEN_MODULES = {"pyarrow"}
 # attribute call) — persisted states are versioned exact-width binary.
 SERDE_FILES = [
     os.path.join("deequ_tpu", "repository", "states.py"),
+    os.path.join("deequ_tpu", "repository", "audit.py"),
     os.path.join("deequ_tpu", "analyzers", "state_provider.py"),
 ]
+# Telemetry surfaces where forensics row samples are banned: these
+# records leave the trust boundary (scrapes, dashboards, log shippers),
+# and sampled row values must never ride along.
+FORENSICS_FILES = [
+    os.path.join("deequ_tpu", "observe", "telemetry.py"),
+    os.path.join("deequ_tpu", "observe", "heartbeat.py"),
+    os.path.join("deequ_tpu", "repository", "engine.py"),
+]
+FORENSICS_FORBIDDEN_MODULE = "deequ_tpu.observe.forensics"
+FORENSICS_FORBIDDEN_NAMES = {
+    "ViolationSample",
+    "ConstraintForensics",
+    "ForensicsReport",
+    "render_forensics",
+}
 DECODE_FORBIDDEN_ATTRS = {"to_numpy", "frombuffer"}
 # Host pack idioms banned inside the decode-to-wire fused path (any
 # function or class whose name contains `wire`): the wire kernels emit
@@ -398,6 +425,55 @@ def check_serde_pickle(path: str) -> List[str]:
                 f"`{node.func.value.id}.{node.func.attr}(...)` call in a "
                 f"state serde path — use the versioned binary envelope"
             )
+    return findings
+
+
+# -- FORENSICS: no row samples on telemetry surfaces --------------------------
+
+
+def check_forensics_leak(path: str) -> List[str]:
+    """Flag imports of deequ_tpu.observe.forensics and any use of its
+    row-sample identifiers in telemetry-surface files. Telemetry records
+    (`engine.*` series, OpenMetrics text, heartbeat snapshots) are
+    operational metadata that leaves the trust boundary; sampled row
+    VALUES stay in the audit trail an operator explicitly loads."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    findings: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == FORENSICS_FORBIDDEN_MODULE or (
+                    alias.name.startswith(FORENSICS_FORBIDDEN_MODULE + ".")
+                ):
+                    findings.append(
+                        f"{_rel(path)}:{node.lineno}: FORENSICS "
+                        f"`{alias.name}` import on a telemetry surface — "
+                        f"sampled row values must never reach engine.* "
+                        f"records, OpenMetrics text, or heartbeat output"
+                    )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module == FORENSICS_FORBIDDEN_MODULE or node.module.startswith(
+                FORENSICS_FORBIDDEN_MODULE + "."
+            ):
+                findings.append(
+                    f"{_rel(path)}:{node.lineno}: FORENSICS import from "
+                    f"`{node.module}` on a telemetry surface — sampled row "
+                    f"values must never reach engine.* records, OpenMetrics "
+                    f"text, or heartbeat output"
+                )
+        else:
+            name = None
+            if isinstance(node, ast.Name):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            if name in FORENSICS_FORBIDDEN_NAMES:
+                findings.append(
+                    f"{_rel(path)}:{node.lineno}: FORENSICS `{name}` on a "
+                    f"telemetry surface — row-sample types are banned here; "
+                    f"row evidence belongs to the audit trail only"
+                )
     return findings
 
 
@@ -745,6 +821,11 @@ def main() -> int:
         path = os.path.join(REPO, rel)
         if os.path.exists(path):
             findings.extend(check_serde_pickle(path))
+
+    for rel in FORENSICS_FILES:
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):
+            findings.extend(check_forensics_leak(path))
 
     for path in _python_files():
         rel = _rel(path)
