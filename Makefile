@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint typecheck analyze sentinel test test-fast trace-demo bench-pushdown bench-decode bench-wire bench-incremental bench-reader bench-forensics clean-native
+.PHONY: lint typecheck analyze sentinel test test-fast trace-demo chaos bench-pushdown bench-decode bench-wire bench-incremental bench-reader bench-forensics bench-chaos clean-native
 
 lint:
 	$(PY) tools/lint.py
@@ -84,6 +84,25 @@ bench-reader:
 BENCH_FORENSICS_ROWS ?= 2000000
 bench-forensics:
 	JAX_PLATFORMS=cpu BENCH_MODE=forensics BENCH_ROWS=$(BENCH_FORENSICS_ROWS) $(PY) bench.py
+
+# seeded fault matrix (ISSUE 13): the chaos harness's injection
+# schedule determinism + retry/cancel/watchdog semantics, the chaos
+# differential (IO errors, short reads, corrupt pages, worker deaths,
+# stalls -> bit-identical on both placements), the SIGKILL-resume
+# test, and the injected-fault shutdown audits
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_suite_differential_fuzz.py -q -k "chaos or sigkill"
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pipeline_shutdown.py -q -k "injected or cancellation"
+
+# resilience-machinery A/B on the wide-stream shape: the same
+# verification run plain vs armed (RunController + every fault point
+# deciding at rate 0), bit-identity asserted, plus one seeded fault
+# pass that must land bit-identical. Proves <2% clean-path overhead.
+# Refreshes BENCH_CHAOS.json (methodology: BENCH.md round 14)
+BENCH_CHAOS_ROWS ?= 2000000
+bench-chaos:
+	JAX_PLATFORMS=cpu BENCH_MODE=chaos BENCH_ROWS=$(BENCH_CHAOS_ROWS) $(PY) bench.py
 
 # remove cached native builds (the hash-named .so files): any strays in
 # the package tree from older versions plus the per-user cache dir the

@@ -1978,6 +1978,163 @@ def run_forensics_bench(n_rows: int, reps: int) -> None:
     print(json.dumps(rec))
 
 
+def run_chaos_bench(n_rows: int, reps: int) -> None:
+    """BENCH_MODE=chaos: the resilience machinery's clean-path cost and
+    its fault-mode correctness (ISSUE 13), on the decode bench's
+    50-column wide-stream shape.
+
+    A/B: the identical verification run PLAIN (no controller, chaos
+    harness disarmed) vs ARMED — a RunController with a generous
+    deadline doing per-batch checks/beats, plus an installed fault plan
+    whose rates are all 0.0, so every `fault_point` seam takes the full
+    decide() path (lock + counter + hash) without injecting. The armed
+    side must stay within 2% of plain (the analytic companion bound
+    lives in tests/test_observe_overhead.py).
+
+    Then a seeded FAULT pass: transient pread errors, short reads,
+    corrupt pages, decode failures and a stage fault all injected in
+    one run — the bench aborts unless statuses and metrics are
+    bit-identical to the plain side (containment never changes an
+    answer). Refreshes BENCH_CHAOS.json (round/config preserved)."""
+    import pyarrow.parquet as pq
+
+    from deequ_tpu.checks.check import Check, CheckLevel
+    from deequ_tpu.core.controller import RunController
+    from deequ_tpu.data.table import Table
+    from deequ_tpu.testing import faults
+    from deequ_tpu.verification.suite import VerificationSuite
+
+    path = os.environ.get("BENCH_PARQUET", "/tmp/bench_decode.parquet")
+    t_gen = time.perf_counter()
+    if not (
+        os.path.exists(path) and pq.ParquetFile(path).metadata.num_rows == n_rows
+    ):
+        write_decode_parquet(n_rows, path)
+    gen_s = time.perf_counter() - t_gen
+
+    check = (
+        Check(CheckLevel.ERROR, "chaos bench")
+        .is_complete("f00")
+        .has_min("f01", lambda v: v >= 0.0)
+        .has_max("f02", lambda v: v <= 1e6)
+        .satisfies("f03 >= 0", "f03 nonneg", lambda r: r >= 0.9)
+    )
+
+    def run_once(controller=None):
+        builder = (
+            VerificationSuite()
+            .on_data(Table.scan_parquet(path, batch_rows=1 << 20))
+            .add_check(check)
+        )
+        if controller is not None:
+            builder = builder.with_controller(controller)
+        result = builder.run()
+        snapshot = {}
+        for analyzer, metric in result.metrics.items():
+            value = metric.value
+            v = value.get() if value.is_success else type(value.exception).__name__
+            if isinstance(v, float) and v != v:
+                v = "nan"
+            snapshot[repr(analyzer)] = v
+        statuses = tuple(
+            (cr.status.name)
+            for cres in result.check_results.values()
+            for cr in cres.constraint_results
+        )
+        return (statuses, snapshot)
+
+    warm_key = run_once()  # warm-up: jit + imports
+
+    plain_s = float("inf")
+    plain_key = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        plain_key = run_once()
+        plain_s = min(plain_s, time.perf_counter() - t0)
+
+    # armed-but-quiet: every fault seam decides (rate 0), the controller
+    # checks and beats every batch against a deadline that never trips
+    quiet_spec = "seed=1," + ",".join(
+        f"{point}:0.0" for point in sorted(faults.FAULT_POINTS)
+    )
+    armed_s = float("inf")
+    armed_key = None
+    with faults.install(quiet_spec):
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            armed_key = run_once(RunController(deadline_s=3600.0))
+            armed_s = min(armed_s, time.perf_counter() - t0)
+
+    # seeded fault pass: inject for real, demand the same bits
+    fault_spec = (
+        "seed=13,read.pread:0.3:5,read.short:0.3:3,read.corrupt:0.5:2,"
+        "decode.chunk:0.5:4,pipeline.stage:1.0:1"
+    )
+    with faults.install(fault_spec) as plan:
+        t0 = time.perf_counter()
+        faulted_key = run_once(RunController(deadline_s=3600.0))
+        faulted_s = time.perf_counter() - t0
+        injected = dict(plan.injected)
+
+    if not (warm_key == plain_key == armed_key == faulted_key):
+        raise SystemExit(
+            "chaos A/B: result mismatch across plain/armed/faulted sides\n"
+            f"plain:   {plain_key}\narmed:   {armed_key}\n"
+            f"faulted: {faulted_key}"
+        )
+
+    overhead_pct = (
+        100.0 * (armed_s - plain_s) / plain_s if plain_s > 0 else 0.0
+    )
+    rec = {
+        "metric": "chaos_overhead_pct",
+        "value": round(overhead_pct, 1),
+        "unit": "%",
+        "rows": n_rows,
+        "chaos_ab": {
+            "plain_s": round(plain_s, 2),
+            "armed_s": round(armed_s, 2),
+            "overhead_pct": round(overhead_pct, 1),
+            "rows_per_sec_plain": round(n_rows / plain_s, 1),
+            "rows_per_sec_armed": round(n_rows / armed_s, 1),
+            "bit_identical": True,
+            "reps": reps,
+            "passes": (
+                "one warm-up (plain), then best-of-reps warm-jit timed "
+                "passes per side, plain first; armed = RunController "
+                "with a 3600s deadline + every fault point at rate 0"
+            ),
+        },
+        "fault_pass": {
+            "spec": fault_spec,
+            "injected": injected,
+            "wall_s": round(faulted_s, 2),
+            "bit_identical": True,
+        },
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "BENCH_CHAOS.json")
+    try:
+        with open(out_path) as fh:
+            old = json.load(fh)
+        for key in ("round", "config"):
+            if key in old and key not in rec:
+                rec[key] = old[key]
+    except Exception:  # noqa: BLE001 - first write: no fields to carry
+        pass
+    with open(out_path, "w") as fh:
+        json.dump(rec, fh)
+        fh.write("\n")
+    total_injected = sum(injected.values())
+    print(
+        f"# bench: chaos A/B plain={plain_s:.2f}s armed={armed_s:.2f}s "
+        f"(+{overhead_pct:.1f}%); fault pass {faulted_s:.2f}s with "
+        f"{total_injected} injections, bit-identical; gen={gen_s:.1f}s",
+        file=sys.stderr,
+    )
+    print(json.dumps(rec))
+
+
 def main() -> None:
     platform = os.environ.get("BENCH_PLATFORM")
     if platform:
@@ -2023,6 +2180,11 @@ def main() -> None:
     if mode == "forensics":
         # self-contained A/B with its own JSON record and artifact
         run_forensics_bench(n_rows, reps)
+        return
+
+    if mode == "chaos":
+        # self-contained A/B with its own JSON record and artifact
+        run_chaos_bench(n_rows, reps)
         return
 
     t_gen = time.perf_counter()

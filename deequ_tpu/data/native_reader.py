@@ -30,6 +30,7 @@ import numpy as np
 
 from deequ_tpu.data.table import Column, ColumnType, pool_empty, shared_all_true
 from deequ_tpu.ops import native, runtime
+from deequ_tpu.testing import faults
 
 __all__ = [
     "ChunkMeta",
@@ -82,7 +83,7 @@ def fadvise_chunk(fd: int, meta: ChunkMeta) -> None:
     Best-effort: platforms without posix_fadvise just skip it."""
     try:
         os.posix_fadvise(fd, meta.offset, meta.nbytes, os.POSIX_FADV_WILLNEED)
-    except (AttributeError, OSError):
+    except (AttributeError, OSError):  # fault-ok: best-effort readahead hint
         pass
 
 
@@ -101,6 +102,8 @@ def decode_chunk(raw: np.ndarray, meta: ChunkMeta) -> Optional[DecodedChunk]:
     Arrow-layout buffers. Returns None on any decode error (truncated
     page, unexpected encoding, corrupt Thrift) — never raises for bad
     bytes; the caller falls back to pyarrow for this column."""
+    if faults.fault_point("decode.chunk") == "fail":
+        return None
     nv = meta.num_values
     if meta.token == "bool":
         out_values = np.zeros((nv + 7) // 8, dtype=np.uint8)

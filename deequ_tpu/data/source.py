@@ -42,6 +42,28 @@ JOIN_TIMEOUT_S = 10.0
 READER_INFLIGHT_GETS = 8
 
 
+def _resolve_quietly_fallback(fut) -> None:
+    """Resolve a readahead future to None, tolerating the race where a
+    fetch slot resolves it concurrently. Fallback-designated (the FAULTS
+    lint in tools/lint.py permits the swallowed exception here): losing
+    the race IS the success case."""
+    try:
+        fut.set_result(None)
+    except Exception:  # noqa: BLE001 - racing fetch slot already resolved it
+        pass
+
+
+def _close_all_fallback(handles) -> None:
+    """Best-effort teardown of reader handles. Fallback-designated: a
+    close failure during unwind must never mask the primary error, and
+    the fd itself is bounded by the open_files registry."""
+    for handle in handles:
+        try:
+            handle.close()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+
+
 def _arrow_ctype(t) -> ColumnType:
     import pyarrow as pa
 
@@ -247,7 +269,7 @@ class DataSource:
             try:
                 while True:
                     q.get_nowait()
-            except queue.Empty:
+            except queue.Empty:  # fault-ok: drain-until-empty teardown
                 pass
             thread.join(timeout=JOIN_TIMEOUT_S)
         if error:
@@ -696,9 +718,11 @@ class ParquetSource(DataSource):
         import pyarrow as pa
         import pyarrow.parquet as pq
 
+        from deequ_tpu.core.controller import retry_call
         from deequ_tpu.data import native_reader as nr
         from deequ_tpu.observe import heartbeat
         from deequ_tpu.ops import runtime
+        from deequ_tpu.testing import faults
 
         fastpath = self._decode_fastpath_set()
         wire = self._wire_fusion_active()
@@ -720,6 +744,8 @@ class ParquetSource(DataSource):
         tokens = {m.column: m.token for m in metas.values()}
         scanned = [n for n, _ in self._schema_cache]
         stall_s = runtime.source_stall_s()
+        retry_attempts = runtime.retry_budget()
+        retry_base = runtime.retry_base_s()
         str_cols = [
             n for n, t in self._schema_cache if t == ColumnType.STRING
         ]
@@ -794,8 +820,10 @@ class ParquetSource(DataSource):
                         nr.fadvise_chunk(read_fd, m)
                     raw = {}
                     bytes_read = 0
+                    retries = recovered = exhausted = observed = 0
                     sp = _spans.span("page_read", cat="read")
                     with sp, heartbeat.current().timed("read"):
+                        faults.fault_point("read.latency")
                         # the object-store latency model: one ranged
                         # GET per row group. Owning the byte schedule
                         # means the GETs fly concurrently (capped like
@@ -807,8 +835,48 @@ class ParquetSource(DataSource):
                             rounds = -(-len(units[i]) // READER_INFLIGHT_GETS)
                             time.sleep(stall_s * rounds)
                         for g, m in chunks:
-                            data = nr.fetch_chunk(read_fd, m)
+                            # bounded retry + exponential backoff around
+                            # the pread/ranged GET: transient errors and
+                            # short reads re-issue up to the budget; an
+                            # exhausted chunk degrades to the pyarrow
+                            # fallback on the decode side — never a
+                            # failed scan, never a wrong answer
+                            def _fetch(m=m):
+                                faults.fault_point("read.pread")
+                                data = nr.fetch_chunk(read_fd, m)
+                                if (
+                                    data is not None
+                                    and faults.fault_point("read.short")
+                                    == "short"
+                                ):
+                                    return None  # truncated: retryable
+                                return data
+
+                            data, r, rec_ok = retry_call(
+                                _fetch,
+                                attempts=retry_attempts,
+                                base_s=retry_base,
+                                key=f"{self.path}:{i}:{m.column}",
+                            )
+                            retries += r
+                            observed += r
+                            if rec_ok:
+                                recovered += 1
+                            elif data is None:
+                                exhausted += 1
+                                observed += 1
                             if data is not None:
+                                if (
+                                    faults.fault_point("read.corrupt")
+                                    == "corrupt"
+                                ):
+                                    # truncation, not a bit flip: the
+                                    # decoder detects short buffers and
+                                    # returns None (column falls back
+                                    # whole); a flipped payload byte
+                                    # could decode to wrong VALUES
+                                    observed += 1
+                                    data = data[: max(1, len(data) // 2)]
                                 bytes_read += len(data)
                             raw[(g, m.column)] = data
                         if sp:
@@ -817,12 +885,20 @@ class ParquetSource(DataSource):
                                 chunks=len(chunks),
                                 bytes_read=bytes_read,
                             )
+                    if retries or exhausted:
+                        runtime.record_retry(retries, recovered, exhausted)
+                    if observed:
+                        runtime.record_fault(injected=observed)
                 futures[i].set_result(raw)
             except BaseException:  # noqa: BLE001 - degrade to pyarrow
-                pass
+                # a failed fetch slot is contained, never silent: the
+                # unit decodes through the pyarrow fallback and the
+                # degrade is counted in the fault telemetry
+                with _spans.attached(tracer, parent):
+                    runtime.record_fault(injected=1, fallback_units=1)
             finally:
                 if not futures[i].done():
-                    futures[i].set_result(None)
+                    _resolve_quietly_fallback(futures[i])
 
         local = threading.local()
         open_files: List = []
@@ -842,6 +918,7 @@ class ParquetSource(DataSource):
         wire_cols = set(wire.columns) if wire is not None else set()
 
         def decode_unit(i: int) -> List[Table]:
+            faults.fault_point("decode.worker")
             unit = units[i]
             readahead_hit = futures[i].done()
             heartbeat.current().note_readahead(bool(readahead_hit))
@@ -968,10 +1045,23 @@ class ParquetSource(DataSource):
         try:
             while next_unit < len(units) or pending:
                 while next_unit < len(units) and len(pending) < workers + 1:
-                    pending.append(pool.submit(decode_unit, next_unit))
+                    pending.append(
+                        (next_unit, pool.submit(decode_unit, next_unit))
+                    )
                     next_unit += 1
-                fut = pending.popleft()
-                for table in fut.result():
+                unit_i, fut = pending.popleft()
+                try:
+                    tables = fut.result()
+                except Exception:  # noqa: BLE001 - contained: one inline redo
+                    # a decode worker died mid-unit. The fetched bytes
+                    # are still resolved in futures[unit_i], so the unit
+                    # re-decodes inline on the consumer thread — bit
+                    # -identical output, one unit of lost parallelism.
+                    # A second failure is persistent and propagates.
+                    runtime.record_fault(injected=1)
+                    tables = decode_unit(unit_i)
+                    runtime.record_retry(1, 1, 0)
+                for table in tables:
                     yield table
         finally:
             stop.set()
@@ -980,25 +1070,18 @@ class ParquetSource(DataSource):
             fetch_pool.shutdown(wait=False, cancel_futures=True)
             for fut in futures:
                 if not fut.done():
-                    try:
-                        fut.set_result(None)
-                    except Exception:  # noqa: BLE001 - racing fetch slot
-                        pass
-            for fut in pending:
+                    _resolve_quietly_fallback(fut)
+            for _, fut in pending:
                 fut.cancel()
             pool.shutdown(wait=True)
             # no fetch slot may outlive the fd it preads from
             fetch_pool.shutdown(wait=True)
             try:
                 os.close(read_fd)
-            except OSError:
+            except OSError:  # fault-ok: teardown double-close guard
                 pass
             with files_lock:
-                for pf in open_files:
-                    try:
-                        pf.close()
-                    except Exception:  # noqa: BLE001 - teardown best-effort
-                        pass
+                _close_all_fallback(open_files)
 
     def _iter_tables_serial(self, batch_size: int) -> Iterator[Table]:
         import pyarrow.parquet as pq
@@ -1145,6 +1228,7 @@ class ParquetSource(DataSource):
         import pyarrow.parquet as pq
 
         from deequ_tpu.ops import runtime
+        from deequ_tpu.testing import faults
 
         fastpath = self._decode_fastpath_set()
         wire = self._wire_fusion_active()
@@ -1174,6 +1258,7 @@ class ParquetSource(DataSource):
             return pf
 
         def decode_unit(unit: Tuple[int, ...]) -> List[Table]:
+            faults.fault_point("decode.worker")
             pf = _pf()
             with _spans.attached(tracer, parent):
                 with _spans.span(
@@ -1206,21 +1291,32 @@ class ParquetSource(DataSource):
         try:
             while next_unit < len(units) or pending:
                 while next_unit < len(units) and len(pending) < workers + 1:
-                    pending.append(pool.submit(decode_unit, units[next_unit]))
+                    pending.append(
+                        (
+                            units[next_unit],
+                            pool.submit(decode_unit, units[next_unit]),
+                        )
+                    )
                     next_unit += 1
-                fut = pending.popleft()
-                for table in fut.result():
+                unit, fut = pending.popleft()
+                try:
+                    tables = fut.result()
+                except Exception:  # noqa: BLE001 - contained: one inline redo
+                    # a decode worker died mid-unit: re-decode inline on
+                    # the consumer thread (bit-identical — units are
+                    # pure functions of the file). A second failure is
+                    # persistent and propagates.
+                    runtime.record_fault(injected=1)
+                    tables = decode_unit(unit)
+                    runtime.record_retry(1, 1, 0)
+                for table in tables:
                     yield table
         finally:
-            for fut in pending:
+            for _, fut in pending:
                 fut.cancel()
             pool.shutdown(wait=True)
             with files_lock:
-                for pf in open_files:
-                    try:
-                        pf.close()
-                    except Exception:  # noqa: BLE001 - teardown best-effort
-                        pass
+                _close_all_fallback(open_files)
 
     def __repr__(self) -> str:
         return f"ParquetSource({self.path!r}, rows={self._num_rows})"

@@ -219,6 +219,13 @@ class PlanCost:
     #: fields (per-predicate verdicts + eligibility for DQ310/DQ311);
     #: None when no row-group statistics reached the planner
     prune: Optional[Any] = None
+    #: resilience knobs the run will execute under: the transient-IO
+    #: retry budget (DEEQU_TPU_RETRIES) and the caller's deadline in
+    #: seconds (None = unbounded) — rendered in EXPLAIN's resilience
+    #: line and checked by DQ318 (a deadline over an unpartitioned
+    #: source leaves nothing committed for a resume)
+    retry_budget: Optional[int] = None
+    deadline_s: Optional[float] = None
 
     @property
     def total_read_bytes_per_row(self) -> float:
@@ -429,6 +436,7 @@ def analyze_plan(
     row_groups: Optional[Sequence[Any]] = None,
     decode_types: Optional[Dict[str, str]] = None,
     partitions: Optional[Sequence[Any]] = None,
+    deadline_s: Optional[float] = None,
 ) -> PlanCost:
     """Abstract interpretation of `AnalysisRunner._do_analysis_run`:
     dedupe -> static precondition filtering (zero-row table) ->
@@ -520,6 +528,8 @@ def analyze_plan(
         num_hosts=max(1, int(num_hosts)),
         counters={k: 0 for k in COUNTERS},
         span_counts={k: 0 for k in EXECUTION_SPANS},
+        retry_budget=runtime.retry_budget(),
+        deadline_s=float(deadline_s) if deadline_s is not None else None,
     )
     spans = cost.span_counts
     counters = cost.counters

@@ -54,6 +54,7 @@ CODES = {
     "DQ315": "column-chunk falls off the native parquet reader",
     "DQ316": "constraint falls off row-level failure forensics",
     "DQ317": "forensics audit-trail entry unusable; forensics unavailable",
+    "DQ318": "deadline set but the source has no partition boundaries",
 }
 
 

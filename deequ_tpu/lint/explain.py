@@ -306,6 +306,24 @@ def cost_diagnostics(
                     source=col,
                 )
             )
+
+    # DQ318 — a deadline over a source with no partition boundaries:
+    # nothing commits to the state repository mid-run, so a deadline
+    # trip loses ALL scanned work — the rerun starts from zero instead
+    # of resuming at the partitions already folded
+    if cost.deadline_s is not None and (
+        scan is None or scan.partitions_total is None
+    ):
+        diags.append(
+            Diagnostic(
+                "DQ318",
+                Severity.WARNING,
+                f"deadline {cost.deadline_s:g}s set but the source has no "
+                "partition boundaries: a deadline trip discards all "
+                "progress (a partitioned source + StateRepository resumes "
+                "at the partitions already committed)",
+            )
+        )
     return diags
 
 
@@ -449,6 +467,17 @@ def render_explain(
                 "  per-batch wire time unmeasured "
                 "(no cached link-bandwidth probe)"
             )
+    if cost.retry_budget is not None or cost.deadline_s is not None:
+        scan = cost.scan_pass
+        resume = (
+            f"{scan.partitions_cached} cached partitions"
+            if scan is not None and scan.partitions_cached is not None
+            else "none (unpartitioned source)"
+        )
+        line = f"resilience: retries={cost.retry_budget}, resume={resume}"
+        if cost.deadline_s is not None:
+            line += f", deadline={cost.deadline_s:g}s"
+        body.append(line)
     sig = cost.dispatch_signature()
     body.append(
         "predicted counters: "
@@ -536,6 +565,7 @@ def explain_plan(
     row_groups: Optional[Sequence] = None,
     decode_types: Optional[Dict[str, str]] = None,
     partitions: Optional[Sequence] = None,
+    deadline_s: Optional[float] = None,
 ) -> ExplainResult:
     """EXPLAIN an analysis plan against a `Table` (schema and row count
     are taken from it — still zero data scanned) or a `SchemaInfo`.
@@ -597,6 +627,7 @@ def explain_plan(
         row_groups=row_groups,
         decode_types=decode_types,
         partitions=partitions,
+        deadline_s=deadline_s,
     )
     diagnostics = cost_diagnostics(cost, plan, schema)
     # DQ316 — failure-forensics capability, predicted from the SAME

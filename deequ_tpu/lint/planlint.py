@@ -388,6 +388,7 @@ def validate_plan(
     stream_batch_rows: Optional[int] = None,
     row_groups: Optional[Sequence] = None,
     partitions: Optional[Sequence] = None,
+    deadline_s: Optional[float] = None,
 ) -> LintReport:
     """Run the full static pass: semantic lints (DQ1xx/DQ2xx) plus the
     cost analyzer's performance lints (DQ3xx, lint/explain.py). The
@@ -414,6 +415,7 @@ def validate_plan(
             stream_batch_rows=stream_batch_rows,
             row_groups=row_groups,
             partitions=partitions,
+            deadline_s=deadline_s,
         )
         report.extend(cost_diagnostics(report.plan_cost, plan, schema))
     except Exception:  # noqa: BLE001 — cost lint must never break a run

@@ -126,6 +126,38 @@ def record_reader_chunks(native: int, fallback: int, total: int) -> None:
         tracer.count("reader_chunks_total", int(total))
 
 
+def record_retry(attempts: int, recovered: int, exhausted: int) -> None:
+    """Transient-IO retry outcome of one readahead fetch operation:
+    backoff sleeps taken, whether the operation recovered after >=1
+    retry, and whether the budget ran dry (the unit then degrades to
+    the pyarrow fallback — never a wrong answer). Tracer-only, like
+    record_pruned_groups; the counters feed the
+    `engine.retry.recovery_ratio` telemetry series the sentinel
+    watches."""
+    tracer = spans.current_tracer()
+    if tracer is not None:
+        if attempts:
+            tracer.count("retry.attempts", int(attempts))
+        if recovered:
+            tracer.count("retry.recovered", int(recovered))
+        if exhausted:
+            tracer.count("retry.exhausted", int(exhausted))
+
+
+def record_fault(injected: int = 0, fallback_units: int = 0) -> None:
+    """Fault-containment accounting: faults observed at engine fault
+    points (injected by the chaos harness or real transient IO errors),
+    and decode units that degraded to the pyarrow fallback because of
+    one. Tracer-only; feeds the `engine.fault.fallback_ratio` telemetry
+    series the sentinel watches."""
+    tracer = spans.current_tracer()
+    if tracer is not None:
+        if injected:
+            tracer.count("fault.observed", int(injected))
+        if fallback_units:
+            tracer.count("fault.fallback_units", int(fallback_units))
+
+
 def record_state_cache(cached: int, scanned: int, total: int) -> None:
     """Partition-split outcome of one partitioned fused scan: partitions
     whose states loaded from the state cache vs partitions that decoded

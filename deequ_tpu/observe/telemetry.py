@@ -199,6 +199,27 @@ def engine_metric_record(
             rec.get("engine.counter.partitions_cached", 0.0) / partitions_total
         )
 
+    # derived: fraction of retried transient-IO operations that
+    # recovered within the retry budget (the rest degraded to the
+    # pyarrow fallback) — the sentinel watches it dropping; only present
+    # when a retry outcome was actually recorded
+    retried = rec.get("engine.counter.retry.recovered", 0.0) + rec.get(
+        "engine.counter.retry.exhausted", 0.0
+    )
+    if retried > 0.0:
+        rec["engine.retry.recovery_ratio"] = (
+            rec.get("engine.counter.retry.recovered", 0.0) / retried
+        )
+
+    # derived: fraction of observed faults that cost a unit its native
+    # decode (degraded to the pyarrow fallback) — the sentinel watches
+    # it rising; only present when a fault was actually observed
+    faults = rec.get("engine.counter.fault.observed", 0.0)
+    if faults > 0.0:
+        rec["engine.fault.fallback_ratio"] = (
+            rec.get("engine.counter.fault.fallback_units", 0.0) / faults
+        )
+
     # satellite: traced_run stamps these on the root span; live /proc read
     # covers traces produced before the attributes existed.
     res = proc_resources()

@@ -28,6 +28,7 @@ import numpy as np
 from deequ_tpu import observe
 from deequ_tpu.analyzers.base import ScanShareableAnalyzer
 from deequ_tpu.analyzers.states import State
+from deequ_tpu.core.controller import RunCancelled, StallWatchdog
 from deequ_tpu.data.table import Table
 from deequ_tpu.ops import pipeline, runtime
 
@@ -1798,6 +1799,7 @@ class FusedScanPass:
         batch_size: Optional[int] = None,
         state_cache=None,
         forensics=None,
+        controller=None,
     ):
         self.analyzers = list(analyzers)
         # None = unset: the pass may widen the default for pure-host
@@ -1814,6 +1816,10 @@ class FusedScanPass:
         # row-level violation capture + provenance notes. The off path
         # is one falsy check per batch — provably inert
         self._forensics = forensics
+        # core/controller.RunController (or None, the default): the
+        # cooperative cancel/deadline token honored at batch granularity
+        # — the off path is one `is not None` check per batch
+        self._controller = controller
 
     def run(self, table: Table) -> List[AnalyzerRunResult]:
         if getattr(table, "partitions", None) is not None:
@@ -1908,6 +1914,10 @@ class FusedScanPass:
                         assisted_idx, assisted, assisted_states
                     ):
                         results[i] = AnalyzerRunResult(analyzer, state=state)
+            except RunCancelled:
+                # deliberate early exit, not an analyzer failure: the
+                # caller resumes from committed partition states
+                raise
             except Exception as e:  # noqa: BLE001
                 for i in merge_idx + assisted_idx + host_idx + host_assisted_idx:
                     results.setdefault(i, AnalyzerRunResult(self.analyzers[i], error=e))
@@ -1950,7 +1960,20 @@ class FusedScanPass:
         merged: Optional[List[AnalyzerRunResult]] = None
         cached_n = 0
         scanned_n = 0
+        ctl = self._controller
         for part in parts:
+            if ctl is not None:
+                # partition boundaries are the resume points: every
+                # partition finished before this check committed its
+                # states above, so a cancel here loses no work
+                ctl.check(
+                    where=f"partition {part.name}",
+                    progress={
+                        "partitions_done": cached_n + scanned_n,
+                        "partitions_total": len(parts),
+                        "partitions_cached": cached_n,
+                    },
+                )
             results: Optional[List[AnalyzerRunResult]] = None
             if cache is not None:
                 sp = observe.span(
@@ -1980,6 +2003,7 @@ class FusedScanPass:
                         if cap is not None
                         else None
                     ),
+                    controller=ctl,
                 )
                 results = sub.run(part.source())
                 scanned_n += 1
@@ -2110,6 +2134,17 @@ class FusedScanPass:
             ),
             name="fused_scan",
         )
+        ctl = self._controller
+        watchdog = None
+        if ctl is not None:
+            wd_s = runtime.stall_watchdog_s()
+            if wd_s > 0:
+                # per-stage forensics on stall: the live heartbeat
+                # snapshot (bottleneck/occupancy/readahead) when the
+                # heartbeat runs, else deequ-* thread stacks
+                watchdog = StallWatchdog(
+                    ctl, wd_s, snapshot_fn=progress.snapshot
+                ).start()
         try:
             if streaming and runtime.pipeline_enabled():
                 scanned_rows, scanned_batches, device_error = self._scan_pipelined(
@@ -2121,6 +2156,14 @@ class FusedScanPass:
                 )
             else:
                 for batch in table.batches(batch_size):
+                    if ctl is not None:
+                        ctl.check(
+                            where="fused_scan batch",
+                            progress={
+                                "batches": scanned_batches,
+                                "rows": scanned_rows,
+                            },
+                        )
                     # per-key builds with error capture: a failing input (e.g.
                     # a predicate over a missing column) fails only the
                     # analyzers that need it — host members individually, the
@@ -2212,8 +2255,12 @@ class FusedScanPass:
                             self._forensics.capture_batch(batch, scanned_rows)
                     scanned_rows += batch.num_rows
                     scanned_batches += 1
+                    if ctl is not None:
+                        ctl.beat()
                     progress.advance(batch.num_rows)
         finally:
+            if watchdog is not None:
+                watchdog.stop()
             progress.finish()
 
         observe.annotate(rows=scanned_rows, batches=scanned_batches)
@@ -2351,6 +2398,7 @@ class FusedScanPass:
         scanned_rows = 0
         scanned_batches = 0
         device_error: Optional[BaseException] = None
+        ctl = self._controller
         items = pipeline.staged(
             table.batches(batch_size), _prep, name="prep", progress=progress
         )
@@ -2359,6 +2407,17 @@ class FusedScanPass:
                 "pipe_stage", cat="pipeline", stage="fold"
             ) as stage_sp:
                 for item in items:
+                    if ctl is not None:
+                        # raising here unwinds through closing(items):
+                        # the same shutdown contract an exhausted scan
+                        # uses joins every stage thread and fd
+                        ctl.check(
+                            where="pipelined fold batch",
+                            progress={
+                                "batches": scanned_batches,
+                                "rows": scanned_rows,
+                            },
+                        )
                     batch, built, packed_inputs, layout, device_exc = item
                     device_live = use_device and device_error is None
                     host_live = any(i not in host_errors for i, _m in all_host)
@@ -2410,6 +2469,8 @@ class FusedScanPass:
                                 )
                     scanned_rows += batch.num_rows
                     scanned_batches += 1
+                    if ctl is not None:
+                        ctl.beat()
                     progress.advance(batch.num_rows)
                 if stage_sp:
                     stage_sp.set(items=scanned_batches)

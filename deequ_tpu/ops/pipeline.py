@@ -57,6 +57,7 @@ from typing import Any, Callable, Iterable, Iterator, List
 
 from deequ_tpu import observe
 from deequ_tpu.ops import runtime
+from deequ_tpu.testing import faults
 
 _SENTINEL = object()
 
@@ -121,6 +122,10 @@ def staged(
                 continue
         return False
 
+    def _apply(item: Any) -> Any:
+        faults.fault_point("pipeline.stage")
+        return fn(item)
+
     def worker() -> None:
         it = iter(iterable)
         try:
@@ -145,7 +150,17 @@ def staged(
                             rows = getattr(item, "num_rows", None)
                             if sp and rows is not None:
                                 sp.set(rows=int(rows))
-                            out = fn(item)
+                            faults.fault_point("pipeline.stall")
+                            try:
+                                out = _apply(item)
+                            except Exception:  # noqa: BLE001 - one redo
+                                # contained stage fault: fn is a pure
+                                # per-batch prep, so one in-place redo
+                                # is bit-identical; a second failure is
+                                # a real error and propagates
+                                runtime.record_fault(injected=1)
+                                out = _apply(item)
+                                runtime.record_retry(1, 1, 0)
                         if not _put(out):
                             return
                         items += 1
@@ -178,7 +193,7 @@ def staged(
         try:
             while True:
                 q.get_nowait()
-        except queue.Empty:
+        except queue.Empty:  # fault-ok: drain-until-empty teardown
             pass
         thread.join(timeout=JOIN_TIMEOUT_S)
     if error:

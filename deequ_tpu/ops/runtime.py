@@ -464,6 +464,63 @@ def source_stall_s() -> float:
         return 0.0
 
 
+def retry_budget() -> int:
+    """Bounded retries per transient-IO operation in the readahead
+    fetch path (`DEEQU_TPU_RETRIES`, default 3, 0 = no retry): a failed
+    or short pread/ranged GET re-issues with exponential backoff up to
+    this many times before the unit degrades to the pyarrow fallback —
+    a retried transient fault costs milliseconds, an exhausted budget
+    costs one unit's fallback decode, and neither ever changes a metric
+    (the chaos differential in tests/test_suite_differential_fuzz.py
+    pins bit-identity under injected faults). Outcomes are counted as
+    `engine.retry.*` telemetry watched by the sentinel."""
+    import os
+
+    raw = os.environ.get("DEEQU_TPU_RETRIES", "")
+    if not raw:
+        return 3
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return 3
+
+
+def retry_base_s() -> float:
+    """First-retry backoff in seconds (`DEEQU_TPU_RETRY_BASE_MS`,
+    default 10ms): attempt k sleeps `base * 2^k` with deterministic
+    jitter (core/controller.backoff_s). Tests shrink it to keep chaos
+    runs fast; production leaves the default so a flapping object store
+    is not hammered."""
+    import os
+
+    raw = os.environ.get("DEEQU_TPU_RETRY_BASE_MS", "")
+    if not raw:
+        return 0.010
+    try:
+        return max(0.0, float(raw)) / 1000.0
+    except ValueError:
+        return 0.010
+
+
+def stall_watchdog_s() -> float:
+    """Stall-watchdog window in seconds (`DEEQU_TPU_STALL_WATCHDOG_S`,
+    default 0 = off): when positive AND a RunController is attached to
+    the run, a watchdog thread checks the controller's per-batch beat
+    counter every window; one silent window dumps per-stage state to
+    stderr (heartbeat snapshot when live, else engine thread stacks),
+    two consecutive silent windows cancel the run with DQ404 — a wedged
+    scan fails with forensics instead of hanging forever."""
+    import os
+
+    raw = os.environ.get("DEEQU_TPU_STALL_WATCHDOG_S", "")
+    if not raw:
+        return 0.0
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return 0.0
+
+
 def heartbeat_s() -> float:
     """Live scan heartbeat interval in seconds (`DEEQU_TPU_HEARTBEAT_S`,
     default 0 = off): when positive, streaming scans emit periodic
@@ -628,6 +685,14 @@ def record_state_cache(cached: int, scanned: int, total: int) -> None:
 
 def record_reader_chunks(native: int, fallback: int, total: int) -> None:
     _counters.record_reader_chunks(native, fallback, total)
+
+
+def record_retry(attempts: int, recovered: int, exhausted: int) -> None:
+    _counters.record_retry(attempts, recovered, exhausted)
+
+
+def record_fault(injected: int = 0, fallback_units: int = 0) -> None:
+    _counters.record_fault(injected, fallback_units)
 
 
 def pad_to(arr: np.ndarray, size: int) -> np.ndarray:

@@ -52,6 +52,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from deequ_tpu.core.fsio import FileSystem, LocalFileSystem, resolve_filesystem
+from deequ_tpu.testing import faults
 
 #: envelope magic — "DeeQu STate"; bump STATE_FORMAT_VERSION whenever
 #: any per-family payload format in analyzers/state_provider.py changes
@@ -267,6 +268,7 @@ class StateRepository:
         """One state (or None) per analyzer, or None on any miss or
         decode failure (DQ314 lenient warning) — never a wrong answer."""
         try:
+            faults.fault_point("state.load")
             blob = self._get(dataset, signature, fingerprint)
         except Exception as e:  # noqa: BLE001 — unreadable entry = miss
             _warn_fallback(dataset, fingerprint, f"unreadable: {e}")
@@ -294,6 +296,7 @@ class StateRepository:
         except ValueError:
             return False
         try:
+            faults.fault_point("state.save")
             self._put(dataset, signature, fingerprint, blob)
         except Exception:  # noqa: BLE001 — cache write must never break a run
             return False

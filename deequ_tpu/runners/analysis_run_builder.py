@@ -33,6 +33,24 @@ class AnalysisRunBuilder:
         self._tracing = None
         self._state_repository = None
         self._dataset_name: str = "default"
+        self._controller = None
+        self._deadline_s: Optional[float] = None
+
+    def with_controller(self, controller) -> "AnalysisRunBuilder":
+        """Cooperative run control (deequ_tpu.core.controller): attach a
+        `RunController` whose `cancel()` any thread may call; the run
+        honors it at batch granularity and raises `RunCancelled`
+        (DQ401) carrying progress after every stage thread joined."""
+        self._controller = controller
+        return self
+
+    def with_deadline(self, seconds: float) -> "AnalysisRunBuilder":
+        """Bound the run's wall time: past `seconds` the next batch
+        check raises `RunCancelled` (DQ402). With a partitioned source
+        and a state repository, partitions committed before the trip
+        resume from cache on the rerun."""
+        self._deadline_s = float(seconds)
+        return self
 
     def with_tracing(self, trace=True) -> "AnalysisRunBuilder":
         """Run observability (deequ_tpu.observe): True records a
@@ -65,6 +83,8 @@ class AnalysisRunBuilder:
         `ExplainResult` (render with `str(...)`)."""
         from deequ_tpu.lint.explain import explain_plan
 
+        if self._deadline_s is not None:
+            kwargs.setdefault("deadline_s", self._deadline_s)
         return explain_plan(self._data, analyzers=self._analyzers, **kwargs)
 
     def add_analyzer(self, analyzer: Analyzer) -> "AnalysisRunBuilder":
@@ -116,6 +136,11 @@ class AnalysisRunBuilder:
     def run(self) -> AnalyzerContext:
         from deequ_tpu.runners.analysis_runner import AnalysisRunner
 
+        controller = self._controller
+        if controller is None and self._deadline_s is not None:
+            from deequ_tpu.core.controller import RunController
+
+            controller = RunController(deadline_s=self._deadline_s)
         return AnalysisRunner.do_analysis_run(
             self._data,
             self._analyzers,
@@ -131,4 +156,5 @@ class AnalysisRunBuilder:
             tracing=self._tracing,
             state_repository=self._state_repository,
             dataset_name=self._dataset_name,
+            controller=controller,
         )

@@ -51,6 +51,7 @@ class AnalysisRunner:
         state_repository=None,
         dataset_name: str = "default",
         forensics=None,
+        controller=None,
     ) -> AnalyzerContext:
         if not analyzers:
             return AnalyzerContext.empty()
@@ -78,6 +79,7 @@ class AnalysisRunner:
                 state_repository,
                 dataset_name,
                 forensics,
+                controller,
             )
         if run:
             context.run_trace = run.trace
@@ -100,6 +102,7 @@ class AnalysisRunner:
         state_repository=None,
         dataset_name: str = "default",
         forensics=None,
+        controller=None,
     ) -> AnalyzerContext:
         # partition-state cache (repository/states.py): only partitioned
         # sources have a per-partition fold to cache; the context rides
@@ -183,7 +186,7 @@ class AnalysisRunner:
         # 4. fused scan pass (reference: AnalysisRunner.scala:279-326)
         scanning_results = AnalysisRunner._run_scanning_analyzers(
             data, scanning, aggregate_with, save_states_with, mesh,
-            state_cache, forensics,
+            state_cache, forensics, controller,
         )
 
         # 5. one frequency pass per grouping-column-set
@@ -314,6 +317,7 @@ class AnalysisRunner:
         mesh=None,
         state_cache=None,
         forensics=None,
+        controller=None,
     ) -> AnalyzerContext:
         if not analyzers:
             return AnalyzerContext.empty()
@@ -333,7 +337,8 @@ class AnalysisRunner:
                 results = DistributedScanPass(shareable, mesh=mesh).run(data)
             else:
                 results = FusedScanPass(
-                    shareable, state_cache=state_cache, forensics=forensics
+                    shareable, state_cache=state_cache, forensics=forensics,
+                    controller=controller,
                 ).run(data)
             for result in results:
                 analyzer = result.analyzer

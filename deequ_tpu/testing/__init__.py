@@ -1,0 +1,5 @@
+"""Deterministic test harnesses for the engine (fault injection)."""
+
+from deequ_tpu.testing import faults
+
+__all__ = ["faults"]

@@ -50,6 +50,8 @@ class VerificationRunBuilder:
         self._tracing = None
         self._forensics: Optional[bool] = None
         self._forensics_max_samples: int = 10
+        self._controller = None
+        self._deadline_s: Optional[float] = None
         self._save_check_results_json_path: Optional[str] = None
         self._save_success_metrics_json_path: Optional[str] = None
         self._overwrite_output_files = False
@@ -66,6 +68,8 @@ class VerificationRunBuilder:
         diagnostics, as an `ExplainResult` (render with `str(...)`)."""
         from deequ_tpu.lint.explain import explain_plan
 
+        if self._deadline_s is not None:
+            kwargs.setdefault("deadline_s", self._deadline_s)
         return explain_plan(
             self._data,
             analyzers=self._required_analyzers,
@@ -105,6 +109,25 @@ class VerificationRunBuilder:
         outcomes are bit-identical either way."""
         self._forensics = bool(enabled)
         self._forensics_max_samples = int(max_samples)
+        return self
+
+    def with_controller(self, controller) -> "VerificationRunBuilder":
+        """Cooperative run control (deequ_tpu.core.controller): attach a
+        `RunController` whose `cancel()` any thread may call; the run
+        honors it at batch granularity and raises `RunCancelled`
+        (DQ401) carrying progress after every stage thread joined. With
+        a partitioned source and a state repository, committed
+        partitions resume from cache on the rerun."""
+        self._controller = controller
+        return self
+
+    def with_deadline(self, seconds: float) -> "VerificationRunBuilder":
+        """Bound the run's wall time: past `seconds` the next batch
+        check raises `RunCancelled` (DQ402). Equivalent to
+        `with_controller(RunController(deadline_s=seconds))`; EXPLAIN
+        renders the knob and DQ318 warns when the source has no
+        partition boundaries to resume from."""
+        self._deadline_s = float(seconds)
         return self
 
     def add_check(self, check: Check) -> "VerificationRunBuilder":
@@ -225,6 +248,8 @@ class VerificationRunBuilder:
             dataset_name=self._dataset_name,
             forensics=self._forensics,
             forensics_max_samples=self._forensics_max_samples,
+            controller=self._controller,
+            deadline_s=self._deadline_s,
         )
         # JSON file outputs (reference: VerificationSuite.scala:146-172)
         from deequ_tpu.core.fileio import write_text_output

@@ -57,6 +57,8 @@ class VerificationSuite:
         dataset_name: str = "default",
         forensics: Optional[bool] = None,
         forensics_max_samples: int = 10,
+        controller=None,
+        deadline_s: Optional[float] = None,
     ) -> VerificationResult:
         """reference: VerificationSuite.scala:107-144.
 
@@ -82,7 +84,20 @@ class VerificationSuite:
         trail when a repository + save key are set; False forces off;
         None (default) defers to the DEEQU_TPU_FORENSICS env knob.
         Metrics are bit-identical either way.
+
+        `controller` / `deadline_s` — cooperative run control
+        (deequ_tpu.core.controller): a `RunController` is honored at
+        batch granularity; `cancel()` or a tripped deadline raises
+        `RunCancelled` (DQ401/DQ402) carrying the run's progress after
+        every stage thread and file descriptor joined. `deadline_s`
+        without a controller constructs one. With a partitioned source
+        and a `state_repository`, every partition committed before the
+        cancel loads from cache on the rerun — resumable by default.
         """
+        if controller is None and deadline_s is not None:
+            from deequ_tpu.core.controller import RunController
+
+            controller = RunController(deadline_s=deadline_s)
         with observe.traced_run(
             "verification_suite", enable=tracing, checks=len(checks)
         ) as run:
@@ -115,6 +130,7 @@ class VerificationSuite:
                         validation,
                         state_repository=state_repository,
                         dataset_name=dataset_name,
+                        deadline_s=deadline_s,
                     )
                 )
 
@@ -141,6 +157,7 @@ class VerificationSuite:
                 state_repository=state_repository,
                 dataset_name=dataset_name,
                 forensics=capture,
+                controller=controller,
             )
 
             verification_result = VerificationSuite.evaluate(
@@ -188,6 +205,7 @@ class VerificationSuite:
         validation,
         state_repository=None,
         dataset_name: str = "default",
+        deadline_s=None,
     ):
         """Static plan analysis before any scan -> (diagnostics,
         PlanCost | None). Strict mode propagates the aggregated
@@ -221,6 +239,7 @@ class VerificationSuite:
                 mode=mode,
                 num_rows=int(data.num_rows),
                 partitions=partitions,
+                deadline_s=deadline_s,
             )
             return list(report.diagnostics), report.plan_cost
         except PlanValidationError:

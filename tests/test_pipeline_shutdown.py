@@ -263,3 +263,79 @@ def test_stacked_decode_error_reaches_consumer_through_stage():
     assert src.closed.is_set()
     assert _wait_no_threads("deequ-pipe-t-stkerr")
     assert _wait_no_threads("deequ-decode")
+
+
+# -- chaos: injected faults ride the same shutdown contract (ISSUE 13) --------
+
+
+def test_injected_worker_death_contained_and_leak_free(parquet_path, monkeypatch):
+    """A decode worker killed mid-unit re-decodes inline: same batches,
+    every thread joined, no parquet fd left open."""
+    from deequ_tpu.testing import faults
+
+    # the pool path (where decode.worker lives) needs >1 worker — the
+    # single-core CI box would otherwise route through the serial loop
+    monkeypatch.setenv("DEEQU_TPU_DECODE_WORKERS", "2")
+    clean = [
+        t.num_rows
+        for t in ParquetSource(parquet_path, batch_rows=10_000).batches(10_000)
+    ]
+    with faults.install("seed=7,decode.worker:1.0:1") as plan:
+        rows = [
+            t.num_rows
+            for t in ParquetSource(
+                parquet_path, batch_rows=10_000
+            ).batches(10_000)
+        ]
+    assert plan.injected.get("decode.worker", 0) >= 1, "fault never fired"
+    assert rows == clean
+    assert _wait_no_threads("deequ-decode")
+    targets = _open_fd_targets()
+    if targets is not None:
+        assert parquet_path not in targets, "parquet fd leaked past fault"
+
+
+def test_injected_stage_fault_contained_in_staged():
+    """A stage fn raising once mid-batch redoes in place — the stream
+    sees every item exactly once and the stage thread still joins."""
+    from deequ_tpu.testing import faults
+
+    with faults.install("seed=1,pipeline.stage:1.0:1") as plan:
+        got = list(
+            pipeline.staged(iter(range(50)), lambda x: x * 2, name="t-chaos")
+        )
+    assert plan.injected.get("pipeline.stage", 0) == 1
+    assert got == [x * 2 for x in range(50)]
+    assert _wait_no_threads("deequ-pipe-t-chaos")
+
+
+def test_cancellation_joins_all_stages(parquet_path):
+    """RunCancelled raised in the consumer loop (the fold-side
+    controller check) unwinds the stacked staged-over-batches shape
+    through the same shutdown contract as exhaustion: both threads
+    join, fd released."""
+    from contextlib import closing
+
+    from deequ_tpu.core.controller import RunCancelled, RunController
+
+    ctl = RunController()
+    src = ParquetSource(parquet_path, batch_rows=10_000)
+    with pytest.raises(RunCancelled) as exc_info:
+        with closing(
+            pipeline.staged(
+                src.batches(10_000), lambda t: t.num_rows, name="t-cancel",
+                depth=2,
+            )
+        ) as it:
+            batches = 0
+            for _ in it:
+                batches += 1
+                if batches == 2:
+                    ctl.cancel()
+                ctl.check(where="test fold", progress={"batches": batches})
+    assert exc_info.value.progress == {"batches": 2}
+    assert _wait_no_threads("deequ-pipe-t-cancel"), "prep stage leaked"
+    assert _wait_no_threads("deequ-decode"), "decode thread leaked"
+    targets = _open_fd_targets()
+    if targets is not None:
+        assert parquet_path not in targets, "parquet fd leaked past cancel"

@@ -653,3 +653,118 @@ def test_serde_rule_covers_the_audit_envelope():
     lint = _lint_module()
     sep = os.sep
     assert f"deequ_tpu{sep}repository{sep}audit.py" in set(lint.SERDE_FILES)
+
+
+# -- FAULTS: no swallowed exceptions on fault-containment paths ---------------
+
+
+def test_faults_checker_flags_bare_except():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def worker(q):\n"
+        "    try:\n"
+        "        q.get_nowait()\n"
+        "    except:\n"
+        "        return None\n"
+    )
+    try:
+        findings = lint.check_fault_containment(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+    assert "bare `except:`" in findings[0]
+
+
+def test_faults_checker_flags_swallowed_exception():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def fetch_unit(fd, meta):\n"
+        "    try:\n"
+        "        return read(fd, meta)\n"
+        "    except OSError:\n"
+        "        pass\n"
+    )
+    try:
+        findings = lint.check_fault_containment(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+    assert "silently swallowed" in findings[0]
+
+
+def test_faults_checker_allows_fallback_functions_and_fault_ok():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def _close_all_fallback(fds):\n"
+        "    for fd in fds:\n"
+        "        try:\n"
+        "            close(fd)\n"
+        "        except OSError:\n"
+        "            pass\n"
+        "def drain(q):\n"
+        "    try:\n"
+        "        while True:\n"
+        "            q.get_nowait()\n"
+        "    except Empty:  # fault-ok: drained\n"
+        "        pass\n"
+    )
+    try:
+        findings = lint.check_fault_containment(path)
+    finally:
+        os.unlink(path)
+    assert findings == []
+
+
+def test_faults_checker_allows_counted_handlers():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def worker(item):\n"
+        "    try:\n"
+        "        return fn(item)\n"
+        "    except Exception:\n"
+        "        runtime.record_fault(injected=1)\n"
+        "        return fn(item)\n"
+    )
+    try:
+        findings = lint.check_fault_containment(path)
+    finally:
+        os.unlink(path)
+    assert findings == []
+
+
+def test_faults_registry_parses_harness_points():
+    lint = _lint_module()
+    registered = lint._registered_fault_points()
+    assert registered is not None
+    # the harness's public registry and the lint's AST view must agree
+    from deequ_tpu.testing import faults
+
+    assert registered == set(faults.FAULT_KINDS)
+
+
+def test_faults_registration_flags_unknown_point():
+    lint = _lint_module()
+    registered = lint._registered_fault_points()
+    path = _tmp_source(
+        "from deequ_tpu.testing import faults\n"
+        "def step():\n"
+        "    faults.fault_point('read.pread')\n"
+        "    faults.fault_point('no.such.point')\n"
+    )
+    try:
+        findings = lint.check_fault_registration(path, registered)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+    assert "no.such.point" in findings[0]
+
+
+def test_faults_rule_covers_stage_worker_and_readahead_files():
+    lint = _lint_module()
+    sep = os.sep
+    rels = set(lint.FAULTS_FILES)
+    assert f"deequ_tpu{sep}ops{sep}pipeline.py" in rels
+    assert f"deequ_tpu{sep}data{sep}source.py" in rels
+    assert f"deequ_tpu{sep}data{sep}native_reader.py" in rels
+    for rel in rels:
+        assert os.path.exists(os.path.join(REPO, rel)), rel

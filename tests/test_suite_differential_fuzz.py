@@ -1043,3 +1043,157 @@ def test_forensics_on_off_bit_identical(seed, monkeypatch, tmp_path):
     # warm: every partition merges from cache; capture sees no batches
     assert run("host", "1", True, cached=True) == baseline, (seed, "warm-on")
     assert run("host", "1", False, cached=True) == baseline, (seed, "warm-off")
+
+
+# -- chaos differential: injected faults change nothing (ISSUE 13) -----------
+
+
+#: the fault matrix `make chaos` also sweeps: transient IO errors,
+#: short reads, corrupt pages, decode failures, worker deaths, stage
+#: faults and stalls — every containment path must stay bit-identical
+CHAOS_MATRIX = [
+    "seed=101,read.pread:0.4:4",
+    "seed=102,read.short:0.5:3",
+    "seed=103,read.corrupt:0.5:2",
+    "seed=104,decode.chunk:0.6:3",
+    "seed=105,decode.worker:1.0:1",
+    "seed=106,pipeline.stage:1.0:1",
+    "seed=107,stall=0.005,pipeline.stall:1.0:2",
+    "seed=108,stall=0.005,read.latency:1.0:3",
+]
+
+
+@pytest.mark.parametrize("spec", CHAOS_MATRIX)
+def test_chaos_faults_bit_identical_both_placements(spec, monkeypatch, tmp_path):
+    """The chaos differential: a seeded fault plan injecting IO errors,
+    short reads, corrupt pages, worker deaths or stalls into the scan
+    must produce EXACTLY the clean run's snapshot on both placements —
+    every containment path (retry, inline redo, pyarrow fallback)
+    degrades to the same bits, never a wrong answer."""
+    from deequ_tpu.data.table import Table as TableCls
+    from deequ_tpu.testing import faults
+
+    rng = np.random.default_rng(23_000)
+    table = random_table(rng)
+    checks = [random_check(rng) for _ in range(2)]
+    path = str(tmp_path / "chaos.parquet")
+    table.to_parquet(
+        path, row_group_size=max(64, table.num_rows // 7),
+        dictionary_encode_strings=True,
+    )
+
+    def run(placement):
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+        monkeypatch.setenv("DEEQU_TPU_PIPELINE", "1")
+        # the worker-pool decode path needs >1 worker on a 1-core box
+        monkeypatch.setenv("DEEQU_TPU_DECODE_WORKERS", "2")
+        data = TableCls.scan_parquet(
+            path, batch_rows=max(64, table.num_rows // 5)
+        )
+        builder = VerificationSuite().on_data(data)
+        for check in checks:
+            builder = builder.add_check(check)
+        return suite_snapshot(builder.with_engine("single").run())
+
+    for placement in ("host", "device"):
+        clean = run(placement)
+        with faults.install(spec) as plan:
+            faulted = run(placement)
+        assert sum(plan.injected.values()) >= 1, (
+            f"spec {spec!r} never fired on {placement} — the matrix "
+            f"entry exercises nothing"
+        )
+        assert clean == faulted, (spec, placement, plan.injected)
+
+
+def test_sigkill_resume_scans_only_remaining_partitions(tmp_path):
+    """Crash-safe partial progress end to end: SIGKILL a scan subprocess
+    after its first partition-state commit; the in-process rerun loads
+    the committed partitions from the FileSystemStateRepository, scans
+    ONLY the remainder, and lands bit-equal to a clean full run."""
+    import glob
+    import signal
+    import struct
+    import subprocess
+    import sys
+    import time
+
+    from deequ_tpu.analyzers import Completeness, Mean, Size, StandardDeviation
+    from deequ_tpu.data.table import Table as TableCls
+    from deequ_tpu.repository.states import FileSystemStateRepository
+    from deequ_tpu.runners.analysis_runner import AnalysisRunner
+
+    rng = np.random.default_rng(31_000)
+    data_dir = tmp_path / "dataset"
+    data_dir.mkdir()
+    n_parts = 3
+    for i in range(n_parts):
+        _write_partition(random_table(rng), str(data_dir / f"part-{i}.parquet"))
+    cache_dir = str(tmp_path / "cache")
+
+    child_src = (
+        "from deequ_tpu.analyzers import Completeness, Mean, Size, StandardDeviation\n"
+        "from deequ_tpu.data.table import Table\n"
+        "from deequ_tpu.repository.states import FileSystemStateRepository\n"
+        "from deequ_tpu.runners.analysis_runner import AnalysisRunner\n"
+        f"repo = FileSystemStateRepository({cache_dir!r})\n"
+        f"AnalysisRunner.do_analysis_run(\n"
+        f"    Table.scan_parquet_dataset({str(data_dir)!r}),\n"
+        "    [Size(), Mean('x'), StandardDeviation('x'), Completeness('x')],\n"
+        "    state_repository=repo, dataset_name='sigkill',\n"
+        ")\n"
+    )
+    import os as _os
+
+    env = dict(_os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DEEQU_TPU_STATE_CACHE", None)
+    # slow every row-group read so the kill lands mid-run, not post-run
+    env["DEEQU_TPU_SOURCE_STALL_MS"] = "400"
+    repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + _os.pathsep + env.get("PYTHONPATH", "")
+
+    child = subprocess.Popen(
+        [sys.executable, "-c", child_src],
+        env=env, cwd=repo_root,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.monotonic() + 120.0
+        committed = []
+        while time.monotonic() < deadline:
+            committed = glob.glob(cache_dir + "/**/*.dqstate", recursive=True)
+            if committed:
+                break
+            if child.poll() is not None:
+                pytest.fail("scan subprocess exited before any commit")
+            time.sleep(0.02)
+        assert committed, "no partition state committed within the window"
+    finally:
+        if child.poll() is None:
+            child.send_signal(signal.SIGKILL)
+        child.wait(timeout=30)
+
+    cached_n = len(
+        glob.glob(cache_dir + "/**/*.dqstate", recursive=True)
+    )
+    assert 1 <= cached_n < n_parts, (
+        f"kill landed outside the run: {cached_n}/{n_parts} committed"
+    )
+
+    analyzers = [Size(), Mean("x"), StandardDeviation("x"), Completeness("x")]
+    clean = AnalysisRunner.do_analysis_run(
+        TableCls.scan_parquet_dataset(str(data_dir)), analyzers
+    )
+    resumed = AnalysisRunner.do_analysis_run(
+        TableCls.scan_parquet_dataset(str(data_dir)), analyzers,
+        state_repository=FileSystemStateRepository(cache_dir),
+        dataset_name="sigkill", tracing=True,
+    )
+    counters = resumed.run_trace.counters
+    assert counters["partitions_cached"] == cached_n
+    assert counters["partitions_scanned"] == n_parts - cached_n
+    for a in analyzers:
+        assert struct.pack(">d", clean.metric_map[a].value.get()) == struct.pack(
+            ">d", resumed.metric_map[a].value.get()
+        ), repr(a)

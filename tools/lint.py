@@ -73,6 +73,20 @@ Checks:
              snapshots are operational metadata that leaves the trust
              boundary (dashboards, scrapes, log shippers). Row evidence
              belongs to the audit trail an operator explicitly loads.
+  FAULTS   — fault containment in the stage-worker and readahead files
+             (deequ_tpu/ops/pipeline.py, deequ_tpu/data/source.py,
+             deequ_tpu/data/native_reader.py): no bare `except:` and no
+             silently-swallowed exceptions (a handler whose body is
+             only `pass`) — every contained fault must count itself
+             (runtime.record_fault / record_retry) or land in a degrade
+             path. Designated fallbacks stay exempt: any enclosing
+             function whose name ends `_fallback`, or an except line
+             annotated `# fault-ok: <reason>`. Additionally, every
+             `faults.fault_point("<name>")` literal anywhere in
+             deequ_tpu/ must name a point registered in
+             deequ_tpu/testing/faults.py FAULT_KINDS — an unregistered
+             point can never be exercised by the chaos harness, so the
+             code behind it is untestable dead weight.
   F401*    — unused imports (fallback when ruff is unavailable).
   E722*    — bare `except:` (fallback when ruff is unavailable).
 
@@ -87,7 +101,7 @@ import os
 import shutil
 import subprocess
 import sys
-from typing import Iterator, List
+from typing import Iterator, List, Optional
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 HOT_LOOP_FILES = [os.path.join("deequ_tpu", "ops", "fused.py")]
@@ -141,6 +155,16 @@ SERDE_FILES = [
     os.path.join("deequ_tpu", "repository", "audit.py"),
     os.path.join("deequ_tpu", "analyzers", "state_provider.py"),
 ]
+# Stage-worker and readahead files where swallowed exceptions are
+# banned: a fault contained here must be counted or degrade loudly.
+FAULTS_FILES = [
+    os.path.join("deequ_tpu", "ops", "pipeline.py"),
+    os.path.join("deequ_tpu", "data", "source.py"),
+    os.path.join("deequ_tpu", "data", "native_reader.py"),
+]
+# The chaos harness's registry: every fault_point("<name>") literal in
+# deequ_tpu/ must be a key of FAULT_KINDS in this module.
+FAULTS_REGISTRY = os.path.join("deequ_tpu", "testing", "faults.py")
 # Telemetry surfaces where forensics row samples are banned: these
 # records leave the trust boundary (scrapes, dashboards, log shippers),
 # and sampled row values must never ride along.
@@ -757,6 +781,112 @@ def check_unused_imports(path: str) -> List[str]:
     return findings
 
 
+# -- FAULTS: no swallowed exceptions on the fault-containment paths ----------
+
+
+def check_fault_containment(path: str) -> List[str]:
+    """Flag bare `except:` and silently-swallowed exceptions (handlers
+    whose body is solely `pass`) in the stage-worker and readahead
+    files. A fault contained on these paths must either count itself
+    (runtime.record_fault / record_retry) or degrade into a designated
+    fallback — a handler that does neither hides the exact class of
+    failure the chaos harness exists to exercise. Exempt: any enclosing
+    function whose name ends `_fallback`, and except lines annotated
+    `# fault-ok: <reason>`."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    lines = src.splitlines()
+    findings: List[str] = []
+
+    def walk(node: ast.AST, in_fallback: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            in_fallback = in_fallback or node.name.endswith("_fallback")
+        if isinstance(node, ast.ExceptHandler) and not in_fallback:
+            if node.type is None:
+                findings.append(
+                    f"{_rel(path)}:{node.lineno}: FAULTS bare `except:` on "
+                    f"a fault-containment path — name the exception so "
+                    f"injected faults stay distinguishable from "
+                    f"KeyboardInterrupt/SystemExit"
+                )
+            elif all(isinstance(stmt, ast.Pass) for stmt in node.body) and (
+                "# fault-ok:" not in lines[node.lineno - 1]
+            ):
+                findings.append(
+                    f"{_rel(path)}:{node.lineno}: FAULTS silently swallowed "
+                    f"exception — count it (runtime.record_fault / "
+                    f"record_retry), degrade via a `*_fallback` function, "
+                    f"or annotate the except line `# fault-ok: <reason>`"
+                )
+        for child in ast.iter_child_nodes(node):
+            walk(child, in_fallback)
+
+    walk(tree, False)
+    return findings
+
+
+def _registered_fault_points() -> Optional[set]:
+    """FAULT_KINDS keys from the chaos harness, by AST — None when the
+    registry module or the dict is missing (reported as a finding)."""
+    path = os.path.join(REPO, FAULTS_REGISTRY)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        for target in targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id == "FAULT_KINDS"
+                and isinstance(node.value, ast.Dict)
+            ):
+                return {
+                    key.value
+                    for key in node.value.keys
+                    if isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                }
+    return None
+
+
+def check_fault_registration(path: str, registered: set) -> List[str]:
+    """Flag `fault_point("<name>")` call literals naming a point absent
+    from the harness's FAULT_KINDS registry. An unregistered point can
+    never fire under any DEEQU_TPU_FAULTS spec, so the containment code
+    behind it is unexercisable by `make chaos` — register the point or
+    delete the probe."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    findings: List[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None
+        )
+        if name != "fault_point" or not node.args:
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            continue
+        if arg.value not in registered:
+            findings.append(
+                f"{_rel(path)}:{node.lineno}: FAULTS fault point "
+                f"`{arg.value}` is not registered in "
+                f"{FAULTS_REGISTRY} FAULT_KINDS — the chaos harness "
+                f"can never exercise it"
+            )
+    return findings
+
+
 # -- E722 fallback: bare except ---------------------------------------------
 
 
@@ -827,6 +957,19 @@ def main() -> int:
         if os.path.exists(path):
             findings.extend(check_forensics_leak(path))
 
+    for rel in FAULTS_FILES:
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):
+            findings.extend(check_fault_containment(path))
+
+    registered = _registered_fault_points()
+    if registered is None:
+        findings.append(
+            f"{FAULTS_REGISTRY}: FAULTS chaos-harness registry "
+            f"(FAULT_KINDS dict) not found — fault points cannot be "
+            f"validated"
+        )
+
     for path in _python_files():
         rel = _rel(path)
         if any(
@@ -841,6 +984,10 @@ def main() -> int:
             rel == d or rel.startswith(d + os.sep) for d in OBSPRINT_DIRS
         ):
             findings.extend(check_observe_prints(path))
+        if registered is not None and rel.startswith(
+            "deequ_tpu" + os.sep
+        ):
+            findings.extend(check_fault_registration(path, registered))
 
     if shutil.which("ruff") is not None:
         findings.extend(run_ruff())

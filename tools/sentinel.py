@@ -73,6 +73,16 @@ WATCHED_SERIES: Sequence[Tuple[str, str]] = (
     # hitting (fingerprints churning, plan signature drifting, envelope
     # decode failures falling back to rescan)
     ("engine.state_cache_hit_ratio", "down"),
+    # transient-fault recovery: the fraction of retried IO operations
+    # that recovered within the retry budget; a drop means transient
+    # faults stopped being absorbed (budget misconfigured, backoff too
+    # short for the store's stall profile, faults turned persistent)
+    ("engine.retry.recovery_ratio", "down"),
+    # fault containment cost: the fraction of observed faults that cost
+    # a unit its native decode (degraded to the pyarrow fallback); a
+    # rise means faults are escaping the retry layer and landing on the
+    # slow path
+    ("engine.fault.fallback_ratio", "up"),
 )
 
 #: phases whose share of wall time is watched (rises are bad: a phase
