@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint typecheck analyze sentinel test test-fast trace-demo chaos bench-pushdown bench-decode bench-wire bench-incremental bench-reader bench-forensics bench-chaos clean-native
+.PHONY: lint typecheck analyze sentinel test test-fast trace-demo chaos service-chaos bench-pushdown bench-decode bench-wire bench-incremental bench-reader bench-forensics bench-chaos bench-service clean-native
 
 lint:
 	$(PY) tools/lint.py
@@ -94,6 +94,25 @@ chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py -q
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_suite_differential_fuzz.py -q -k "chaos or sigkill"
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pipeline_shutdown.py -q -k "injected or cancellation"
+
+# fleet-service fault matrix (ISSUE 14): seeded chaos on the four
+# service.* points (admission, queue pop, worker, scheduler tick) with
+# cross-tenant blast-radius containment asserted bit-identically, plus
+# the full service unit/integration suite (admission codes, quotas,
+# breakers, preempt->resume bit-identity, drain audits)
+service-chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_service_chaos.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_service.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pipeline_shutdown.py -q -k "service"
+
+# service scheduling benchmark (ISSUE 14): interactive p99 latency on a
+# single-worker service while a heavy partitioned profile holds the
+# pool — must stay within 2x of solo p99 because every interactive
+# arrival preempts the heavy run at a partition boundary and the heavy
+# run completes from committed states. Refreshes BENCH_SERVICE.json
+BENCH_SERVICE_ROWS ?= 2000000
+bench-service:
+	JAX_PLATFORMS=cpu BENCH_SERVICE_ROWS=$(BENCH_SERVICE_ROWS) $(PY) tools/bench_service.py
 
 # resilience-machinery A/B on the wide-stream shape: the same
 # verification run plain vs armed (RunController + every fault point

@@ -25,6 +25,30 @@ The DQ4xx runtime taxonomy (plan-time lints own DQ1xx-DQ3xx):
     counted in `engine.retry.*` telemetry — never a wrong answer);
   * DQ404 — run stalled: the watchdog saw no batch progress for the
     stall window and cancelled the run after dumping per-stage state.
+
+Service-era additions (ISSUE 14): the `DQService` scheduler needs to
+stop a run WITHOUT losing its committed partition states, so the
+controller also carries a *soft* cancel — `cancel_at_boundary()` —
+that trips only at checks marked `boundary=True` (the partition
+boundaries in `FusedScanPass._run_partitioned`, where every finished
+partition has already committed to the StateRepository). In-flight
+batches keep folding until the current partition lands; the raise then
+unwinds through the same closing() shutdown contract a hard cancel
+uses. Reasons/codes for the soft path:
+
+  * DQ405 — run preempted: the scheduler evicted a heavy run so a
+    cheaper one could take its worker; the submission is requeued and
+    its resume loads the committed partitions (bit-identical — pinned
+    by tests/test_service.py);
+  * DQ406 — run stopped at a partition boundary because the tenant's
+    scan-bytes/disk quota ran out mid-run (admission-time quota
+    rejections are the service's DQ411);
+  * DQ407 — run stopped by a graceful drain (SIGTERM): the partition
+    in flight committed, the rest resumes after restart.
+
+A `boundary_probe` hook — set by the service — runs at every boundary
+check with the run's progress dict and may return a soft-cancel reason
+(the per-partition quota-charging seam).
 """
 
 from __future__ import annotations
@@ -40,12 +64,22 @@ DQ_CANCELLED = "DQ401"
 DQ_DEADLINE = "DQ402"
 DQ_RETRIES_EXHAUSTED = "DQ403"  # reserved — see module docstring
 DQ_STALLED = "DQ404"
+DQ_PREEMPTED = "DQ405"
+DQ_QUOTA = "DQ406"
+DQ_DRAIN = "DQ407"
 
 _REASON_CODES = {
     "cancelled": DQ_CANCELLED,
     "deadline": DQ_DEADLINE,
     "stalled": DQ_STALLED,
+    "preempted": DQ_PREEMPTED,
+    "quota": DQ_QUOTA,
+    "drain": DQ_DRAIN,
 }
+
+#: soft-cancel reasons: these trip only at `boundary=True` checks, so
+#: the partition in flight commits its states before the run unwinds
+SOFT_REASONS = frozenset({"preempted", "quota", "drain"})
 
 
 class RunCancelled(RuntimeError):
@@ -93,6 +127,11 @@ class RunController:
         )
         self._cancel = threading.Event()
         self._reason: str = "cancelled"
+        self._soft_cancel = threading.Event()
+        self._soft_reason: str = "preempted"
+        self._boundary_probe: Optional[
+            Callable[[Dict[str, Any]], Optional[str]]
+        ] = None
         self.beats = 0
 
     def cancel(self, reason: str = "cancelled") -> None:
@@ -102,9 +141,31 @@ class RunController:
             self._reason = reason
             self._cancel.set()
 
+    def cancel_at_boundary(self, reason: str = "preempted") -> None:
+        """Soft cancel: trip the run at its next `boundary=True` check
+        only — batch-granularity checks pass through, so the partition
+        in flight finishes and commits its states before the raise.
+        First soft cancel wins the reason; a hard `cancel()` still
+        overrides everywhere."""
+        if not self._soft_cancel.is_set():
+            self._soft_reason = reason
+            self._soft_cancel.set()
+
+    def set_boundary_probe(
+        self, probe: Optional[Callable[[Dict[str, Any]], Optional[str]]]
+    ) -> None:
+        """Install a hook run at every boundary check with the progress
+        dict; a non-None return soft-cancels with that reason. The
+        service charges per-partition quota usage through it."""
+        self._boundary_probe = probe
+
     @property
     def cancelled(self) -> bool:
         return self._cancel.is_set()
+
+    @property
+    def soft_cancelled(self) -> bool:
+        return self._soft_cancel.is_set()
 
     def remaining_s(self) -> Optional[float]:
         """Seconds until the deadline, or None when none is set."""
@@ -118,15 +179,32 @@ class RunController:
         self.beats += 1
 
     def check(
-        self, where: str = "", progress: Optional[Dict[str, Any]] = None
+        self,
+        where: str = "",
+        progress: Optional[Dict[str, Any]] = None,
+        *,
+        boundary: bool = False,
     ) -> None:
-        """Raise RunCancelled when cancelled or past the deadline."""
+        """Raise RunCancelled when cancelled or past the deadline.
+        `boundary=True` marks a resume point (a partition boundary:
+        everything before it has committed): only there do soft cancels
+        trip and the boundary probe run."""
         if self._cancel.is_set():
             raise RunCancelled(self._reason, where=where, progress=progress)
         if self._deadline_at is not None and time.monotonic() > self._deadline_at:
             self._reason = "deadline"
             self._cancel.set()
             raise RunCancelled("deadline", where=where, progress=progress)
+        if boundary:
+            probe = self._boundary_probe
+            if probe is not None:
+                reason = probe(dict(progress or {}))
+                if reason:
+                    self.cancel_at_boundary(reason)
+            if self._soft_cancel.is_set():
+                raise RunCancelled(
+                    self._soft_reason, where=where, progress=progress
+                )
 
 
 class StallWatchdog:
@@ -267,8 +345,12 @@ def retry_call(
 __all__ = [
     "DQ_CANCELLED",
     "DQ_DEADLINE",
+    "DQ_DRAIN",
+    "DQ_PREEMPTED",
+    "DQ_QUOTA",
     "DQ_RETRIES_EXHAUSTED",
     "DQ_STALLED",
+    "SOFT_REASONS",
     "RunCancelled",
     "RunController",
     "StallWatchdog",

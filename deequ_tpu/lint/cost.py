@@ -226,6 +226,17 @@ class PlanCost:
     #: source leaves nothing committed for a resume)
     retry_budget: Optional[int] = None
     deadline_s: Optional[float] = None
+    #: admission classification (DQService admission control): the cost
+    #: tier this plan lands in — 'interactive' | 'batch' | 'heavy' —
+    #: from the predicted post-prune, post-cache scan bytes against the
+    #: ADMISSION_*_BYTES thresholds. Unknown row counts classify as
+    #: 'batch' (admit, but never preempt others). Set by analyze_plan.
+    admission_tier: Optional[str] = None
+    #: scan-bytes headroom left in the tenant's quota window after this
+    #: plan runs once — set by explain_plan when the caller supplies
+    #: `quota_scan_bytes`; negative means the plan overdraws the window
+    #: and DQ319 fires when it can NEVER fit
+    quota_headroom_bytes: Optional[float] = None
 
     @property
     def total_read_bytes_per_row(self) -> float:
@@ -242,6 +253,23 @@ class PlanCost:
                 return p
         return None
 
+    @property
+    def predicted_scan_bytes(self) -> Optional[float]:
+        """Predicted bytes this plan reads end to end: per-pass read
+        bytes/row × rows, minus what pushdown skips and what cached
+        partition states avoid. None when the row count is unknown —
+        admission then classifies conservatively ('batch')."""
+        if self.num_rows is None:
+            return None
+        total = 0.0
+        for p in self.passes:
+            total += p.read_bytes_per_row * float(self.num_rows)
+        scan = self.scan_pass
+        if scan is not None:
+            total -= float(scan.saved_read_bytes or 0.0)
+            total -= float(scan.saved_partition_bytes or 0.0)
+        return max(0.0, total)
+
     def dispatch_signature(self) -> Dict[str, Any]:
         """The comparable execution shape: counters, span histogram, and
         the deduplicated family-group set — exactly what
@@ -257,6 +285,54 @@ class PlanCost:
             "spans": {k: v for k, v in self.span_counts.items() if v},
             "family_groups": families,
         }
+
+
+# -- admission tiers (DQService admission control) ---------------------------
+
+#: plans predicted to read fewer bytes than this are 'interactive':
+#: they may preempt a running heavy profile (~64 MiB ≈ well under a
+#: second of scan on any placement)
+ADMISSION_INTERACTIVE_BYTES = 64 << 20
+#: plans predicted to read at least this many bytes are 'heavy': they
+#: are preemptible at partition boundaries and never preempt others
+ADMISSION_HEAVY_BYTES = 1 << 30
+
+ADMISSION_TIERS = ("interactive", "batch", "heavy")
+
+
+def _tier_threshold(env: str, default: float) -> float:
+    """Operator override for a tier boundary (fleet tuning: a deploy
+    whose 'interactive' latency budget maps to a different scan size
+    than the defaults)."""
+    import os
+
+    raw = os.environ.get(env, "")
+    if not raw:
+        return float(default)
+    try:
+        return float(raw)
+    except ValueError:
+        return float(default)
+
+
+def cost_tier(cost: "PlanCost") -> str:
+    """Classify a PlanCost into an admission tier from its predicted
+    scan bytes. Unknown row counts land in 'batch': admitted, queued
+    behind interactive work, but never trusted to preempt. Boundaries
+    are overridable via DEEQU_TPU_TIER_INTERACTIVE_BYTES and
+    DEEQU_TPU_TIER_HEAVY_BYTES."""
+    scan_bytes = cost.predicted_scan_bytes
+    if scan_bytes is None:
+        return "batch"
+    if scan_bytes < _tier_threshold(
+        "DEEQU_TPU_TIER_INTERACTIVE_BYTES", ADMISSION_INTERACTIVE_BYTES
+    ):
+        return "interactive"
+    if scan_bytes >= _tier_threshold(
+        "DEEQU_TPU_TIER_HEAVY_BYTES", ADMISSION_HEAVY_BYTES
+    ):
+        return "heavy"
+    return "batch"
 
 
 def cost_drift(cost: "PlanCost", trace: Any) -> Dict[str, float]:
@@ -977,10 +1053,14 @@ def analyze_plan(
                 sum(int(p.get("bytes", 0)) for p in cached)
             )
 
+    cost.admission_tier = cost_tier(cost)
     return cost
 
 
 __all__ = [
+    "ADMISSION_HEAVY_BYTES",
+    "ADMISSION_INTERACTIVE_BYTES",
+    "ADMISSION_TIERS",
     "COUNTERS",
     "EXECUTION_SPANS",
     "PIPELINE_HOST_BYTES_PER_S",
@@ -989,4 +1069,5 @@ __all__ = [
     "PipelineCost",
     "PlanCost",
     "analyze_plan",
+    "cost_tier",
 ]

@@ -55,6 +55,7 @@ CODES = {
     "DQ316": "constraint falls off row-level failure forensics",
     "DQ317": "forensics audit-trail entry unusable; forensics unavailable",
     "DQ318": "deadline set but the source has no partition boundaries",
+    "DQ319": "plan can never be admitted under the tenant's quota window",
 }
 
 

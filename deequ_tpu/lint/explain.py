@@ -58,8 +58,14 @@ def cost_diagnostics(
     cost: PlanCost,
     analyzers: Sequence[Any] = (),
     schema: Optional[SchemaInfo] = None,
+    *,
+    quota_scan_bytes: Optional[float] = None,
 ) -> List[Diagnostic]:
-    """The DQ300-DQ304 performance lints over a computed `PlanCost`."""
+    """The DQ300-DQ304 performance lints over a computed `PlanCost`.
+
+    `quota_scan_bytes` — the tenant's scan-bytes-per-window budget,
+    when known (the DQService admission path supplies it) — arms the
+    DQ319 never-admittable lint."""
     diags: List[Diagnostic] = []
     scan = cost.scan_pass
     scan_columns = set(scan.columns) if scan is not None else set()
@@ -324,6 +330,28 @@ def cost_diagnostics(
                 "at the partitions already committed)",
             )
         )
+
+    # DQ319 — the plan can NEVER be admitted under the tenant's quota:
+    # its predicted scan bytes exceed the whole bytes-per-window budget,
+    # so admission control rejects it every time (DQ410) no matter how
+    # empty the window is — the plan must shrink (filters that push
+    # down, cached partitions, fewer columns) or the quota must grow
+    if quota_scan_bytes is not None:
+        predicted = cost.predicted_scan_bytes
+        if predicted is not None and predicted > float(quota_scan_bytes):
+            diags.append(
+                Diagnostic(
+                    "DQ319",
+                    Severity.WARNING,
+                    f"plan predicts ~{predicted:.0f} scan bytes but the "
+                    f"tenant's quota window admits at most "
+                    f"{float(quota_scan_bytes):.0f}: this plan can never "
+                    "be admitted (rejected DQ410 at every submission) — "
+                    "shed read bytes (pushdown-eligible filters, fewer "
+                    "columns, a partitioned source with cached states) "
+                    "or raise the tenant's scan-bytes quota",
+                )
+            )
     return diags
 
 
@@ -467,6 +495,20 @@ def render_explain(
                 "  per-batch wire time unmeasured "
                 "(no cached link-bandwidth probe)"
             )
+    if cost.admission_tier is not None:
+        scan_bytes = cost.predicted_scan_bytes
+        line = (
+            f"admission: tier={cost.admission_tier}, "
+            f"predicted scan {_fmt_bytes(scan_bytes)}"
+        )
+        if cost.quota_headroom_bytes is not None:
+            headroom = cost.quota_headroom_bytes
+            line += (
+                f", quota headroom ~{_fmt_bytes(headroom)}"
+                if headroom >= 0
+                else f", quota overdrawn by ~{_fmt_bytes(-headroom)}"
+            )
+        body.append(line)
     if cost.retry_budget is not None or cost.deadline_s is not None:
         scan = cost.scan_pass
         resume = (
@@ -566,6 +608,7 @@ def explain_plan(
     decode_types: Optional[Dict[str, str]] = None,
     partitions: Optional[Sequence] = None,
     deadline_s: Optional[float] = None,
+    quota_scan_bytes: Optional[float] = None,
 ) -> ExplainResult:
     """EXPLAIN an analysis plan against a `Table` (schema and row count
     are taken from it — still zero data scanned) or a `SchemaInfo`.
@@ -584,7 +627,11 @@ def explain_plan(
 
     `decode_types` likewise defaults to the source's own decode
     vocabulary (`decode_column_types()`), which turns on the decode
-    fast-path prediction and the per-column DQ312 fallback lints."""
+    fast-path prediction and the per-column DQ312 fallback lints.
+
+    `quota_scan_bytes` — a tenant's scan-bytes-per-window budget (the
+    DQService admission path supplies it) — adds the quota headroom to
+    the `admission:` line and arms the DQ319 never-admittable lint."""
     if isinstance(data_or_schema, SchemaInfo):
         schema = data_or_schema
     else:
@@ -629,7 +676,13 @@ def explain_plan(
         partitions=partitions,
         deadline_s=deadline_s,
     )
-    diagnostics = cost_diagnostics(cost, plan, schema)
+    if quota_scan_bytes is not None:
+        predicted = cost.predicted_scan_bytes
+        if predicted is not None:
+            cost.quota_headroom_bytes = float(quota_scan_bytes) - predicted
+    diagnostics = cost_diagnostics(
+        cost, plan, schema, quota_scan_bytes=quota_scan_bytes
+    )
     # DQ316 — failure-forensics capability, predicted from the SAME
     # static classification the capture itself uses: constraints whose
     # violating rows cannot be identified per batch fall off with the

@@ -41,6 +41,7 @@ __all__ = [
     "ScanProgress",
     "current",
     "env_interval_s",
+    "publish_event",
     "register_callback",
     "scan_heartbeat",
     "start",
@@ -69,6 +70,33 @@ def unregister_callback(fn: Callable[[Dict[str, Any]], None]) -> None:
     with _callback_lock:
         if fn in _callbacks:
             _callbacks.remove(fn)
+
+
+def publish_event(event: str, **fields: Any) -> None:
+    """One-shot discrete pulse (vs the periodic scan snapshots): the DQ
+    service publishes its lifecycle moments — preemptions, sheds,
+    breaker trips, drain — through the same sinks a heartbeat uses, so
+    one JSONL tail (DEEQU_TPU_HEARTBEAT_OUT) or one registered callback
+    sees the whole fleet timeline interleaved with scan progress.
+
+    Best-effort by design: a broken sink must never fail the service
+    hot path, so every sink error is swallowed."""
+    snap: Dict[str, Any] = {"ts": round(time.time(), 3), "event": event}
+    snap.update(fields)
+    with _callback_lock:
+        registered = list(_callbacks)
+    for fn in registered:
+        try:
+            fn(snap)
+        except Exception:  # fault-ok: a sink must not fail the service
+            pass
+    out_path = os.environ.get(ENV_OUT, "").strip()
+    if out_path:
+        try:
+            with open(out_path, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps(snap, sort_keys=True) + "\n")
+        except OSError:  # fault-ok: sink errors never propagate
+            pass
 
 
 def env_interval_s() -> float:

@@ -30,10 +30,12 @@ from deequ_tpu.observe import report
 
 __all__ = [
     "ENGINE_PREFIX",
+    "SERVICE_PREFIX",
     "engine_metric_record",
     "latest_results",
     "openmetrics_text",
     "proc_resources",
+    "service_metric_record",
 ]
 
 #: every key in an engine metric record starts with this prefix, which is
@@ -41,8 +43,35 @@ __all__ = [
 #: data-quality metrics sharing the same repository.
 ENGINE_PREFIX = "engine."
 
+#: the fleet-service slice of the engine namespace: queue depths,
+#: admit/reject/shed/preempt counters, per-tenant scan bytes, breaker
+#: state — produced by `deequ_tpu.service.telemetry` and consumed by the
+#: same exporter/sentinel stack as any other `engine.` series.
+SERVICE_PREFIX = ENGINE_PREFIX + "service."
+
 #: span names whose `rows`/`batches` attributes count scanned work.
 _SCAN_SPANS = ("fused_scan", "dist_scan")
+
+
+def service_metric_record(values: Dict[str, Any]) -> Dict[str, float]:
+    """Normalize a raw service-counter dict into an engine record.
+
+    Keys gain the `engine.service.` prefix when they carry neither it
+    nor the bare `engine.` prefix, and every value is coerced to float
+    (non-finite values are dropped — repositories store finite floats),
+    so ad-hoc dicts from operators' scripts and the `ServiceTelemetry`
+    snapshot land in the repository in the same shape.
+    """
+    rec: Dict[str, float] = {}
+    for key, value in values.items():
+        name = key if key.startswith(ENGINE_PREFIX) else SERVICE_PREFIX + key
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            continue
+        if math.isfinite(v):
+            rec[name] = v
+    return rec
 
 
 # ---------------------------------------------------------------------------

@@ -1973,6 +1973,7 @@ class FusedScanPass:
                         "partitions_total": len(parts),
                         "partitions_cached": cached_n,
                     },
+                    boundary=True,
                 )
             results: Optional[List[AnalyzerRunResult]] = None
             if cache is not None:

@@ -521,6 +521,40 @@ def stall_watchdog_s() -> float:
         return 0.0
 
 
+def service_workers() -> int:
+    """Worker-pool size for the long-lived DQService
+    (`DEEQU_TPU_SERVICE_WORKERS`, default 2): how many suites execute
+    concurrently over the shared pool. Admission control bounds what
+    reaches the pool; this bounds what runs at once."""
+    import os
+
+    raw = os.environ.get("DEEQU_TPU_SERVICE_WORKERS", "")
+    if not raw:
+        return 2
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return 2
+
+
+def service_drain_s() -> float:
+    """Graceful-drain window in seconds for the DQService
+    (`DEEQU_TPU_SERVICE_DRAIN_S`, default 30): on SIGTERM / close(),
+    running suites get this long to commit their in-flight partition
+    and unwind through the soft-cancel (DQ407) before the drain
+    escalates to a hard cancel. Queued work is returned immediately
+    with DQ414 either way."""
+    import os
+
+    raw = os.environ.get("DEEQU_TPU_SERVICE_DRAIN_S", "")
+    if not raw:
+        return 30.0
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return 30.0
+
+
 def heartbeat_s() -> float:
     """Live scan heartbeat interval in seconds (`DEEQU_TPU_HEARTBEAT_S`,
     default 0 = off): when positive, streaming scans emit periodic

@@ -302,6 +302,15 @@ class StateRepository:
             return False
         return True
 
+    # -- accounting ----------------------------------------------------------
+
+    def disk_usage(self, dataset: str) -> Optional[int]:
+        """Bytes of state envelopes stored for `dataset`, or None when
+        the backend cannot account (an opaque object store). The
+        DQService's per-tenant state-disk budget is enforced against
+        this at admission and at partition boundaries."""
+        return None
+
     # -- zero-scan range queries ---------------------------------------------
 
     def merge_range(
@@ -363,6 +372,14 @@ class InMemoryStateRepository(StateRepository):
     def _exists(self, dataset: str, signature: str, fingerprint: str) -> bool:
         with self._lock:
             return (dataset, signature, fingerprint) in self._blobs
+
+    def disk_usage(self, dataset: str) -> Optional[int]:
+        with self._lock:
+            return sum(
+                len(blob)
+                for (ds, _sig, _fp), blob in self._blobs.items()
+                if ds == dataset
+            )
 
 
 def _safe_component(name: str) -> str:
@@ -436,6 +453,27 @@ class FileSystemStateRepository(StateRepository):
 
     def _exists(self, dataset: str, signature: str, fingerprint: str) -> bool:
         return self.fs.exists(self._path(dataset, signature, fingerprint))
+
+    def disk_usage(self, dataset: str) -> Optional[int]:
+        """Sum of `.dqstate` envelope sizes under the dataset's
+        directory (every signature). Local filesystems only — other
+        backends return None (unknown), and the disk-budget enforcement
+        treats unknown as in-budget."""
+        if not isinstance(self.fs, LocalFileSystem):
+            return None
+        root = os.path.join(self.base_path, _safe_component(dataset))
+        if not os.path.isdir(root):
+            return 0
+        total = 0
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for name in filenames:
+                if not name.endswith(".dqstate"):
+                    continue
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, name))
+                except OSError:  # fault-ok: racing delete = size 0
+                    pass
+        return total
 
 
 @dataclass

@@ -1,0 +1,48 @@
+"""Fleet-scale DQ service: run many tenants' suites on one bounded
+worker pool without letting any of them hurt the others.
+
+The package is the "data quality as a service" layer from ISSUE 14:
+
+  * `admission`  — EXPLAIN-first admission control (DQ410/411/413);
+  * `quotas`     — per-tenant budgets + the sliding scan-bytes ledger;
+  * `breaker`    — per-(tenant, dataset) circuit breakers;
+  * `service`    — the `DQService` pool: tiered queues, preemptive
+                   scheduling (interactive bumps heavy at partition
+                   boundaries), shed-on-overload, graceful drain;
+  * `telemetry`  — `engine.service.*` counters the sentinel watches;
+  * `codes`      — the DQ41x submission-outcome codes.
+"""
+
+from .admission import AdmissionController, AdmissionDecision
+from .breaker import BreakerBoard
+from .codes import (
+    CODE_MEANINGS,
+    DQ_BREAKER_OPEN,
+    DQ_DRAINED,
+    DQ_QUOTA_EXCEEDED,
+    DQ_REJECTED,
+    DQ_SHED,
+)
+from .quotas import DEFAULT_QUOTA, QuotaLedger, TenantQuota
+from .service import DEFAULT_QUEUE_LIMITS, TIERS, DQService, SubmissionHandle
+from .telemetry import ServiceTelemetry
+
+__all__ = [
+    "CODE_MEANINGS",
+    "DEFAULT_QUEUE_LIMITS",
+    "DEFAULT_QUOTA",
+    "DQ_BREAKER_OPEN",
+    "DQ_DRAINED",
+    "DQ_QUOTA_EXCEEDED",
+    "DQ_REJECTED",
+    "DQ_SHED",
+    "TIERS",
+    "AdmissionController",
+    "AdmissionDecision",
+    "BreakerBoard",
+    "DQService",
+    "QuotaLedger",
+    "ServiceTelemetry",
+    "SubmissionHandle",
+    "TenantQuota",
+]

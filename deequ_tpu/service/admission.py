@@ -1,0 +1,134 @@
+"""EXPLAIN-first admission control.
+
+Every submission is cost-analyzed *before* any kernel dispatch: the
+same ``explain_plan`` that powers the CLI EXPLAIN runs over the
+submission's schema and checks, and its ``PlanCost`` decides the
+scheduling tier (interactive / batch / heavy) and whether the
+submission can be admitted at all. The gates, in order:
+
+  1. EXPLAIN itself failed, or produced the DQ319 never-admittable
+     lint (the plan predicts more scan bytes than the tenant's whole
+     quota window) -> DQ410 rejected at admission;
+  2. the tenant is at its pending-run budget, or its state-repository
+     disk budget is already blown -> DQ411 quota exceeded;
+  3. the (tenant, dataset) circuit breaker denies entry -> DQ413.
+
+The breaker check runs LAST so a HALF_OPEN probe slot is never
+consumed by a submission that would have been rejected anyway.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+from ..lint.cost import PlanCost
+from ..lint.explain import explain_plan
+from ..testing import faults
+from .breaker import BreakerBoard
+from .codes import DQ_BREAKER_OPEN, DQ_QUOTA_EXCEEDED, DQ_REJECTED
+from .quotas import QuotaLedger
+
+# the EXPLAIN lint that proves a plan can never fit the quota window
+_NEVER_ADMITTABLE_CODE = "DQ319"
+
+
+@dataclass(frozen=True)
+class AdmissionDecision:
+    admitted: bool
+    #: scheduling tier when admitted (interactive | batch | heavy)
+    tier: Optional[str] = None
+    #: DQ41x rejection code when not admitted
+    code: Optional[str] = None
+    reason: str = ""
+    cost: Optional[PlanCost] = None
+
+
+class AdmissionController:
+    """Stateless decision logic over the ledger and breaker board."""
+
+    def __init__(self, ledger: QuotaLedger, breakers: BreakerBoard) -> None:
+        self._ledger = ledger
+        self._breakers = breakers
+
+    def evaluate(
+        self,
+        tenant: str,
+        dataset: str,
+        data: Any,
+        checks: Sequence[Any],
+        analyzers: Sequence[Any],
+        *,
+        pending_count: int,
+        state_disk_usage: Optional[int] = None,
+    ) -> AdmissionDecision:
+        faults.fault_point("service.admission")
+        quota = self._ledger.quota(tenant)
+
+        # gate 1 — EXPLAIN-first: cost the plan before any dispatch
+        try:
+            report = explain_plan(
+                data,
+                analyzers=analyzers,
+                checks=checks,
+                quota_scan_bytes=quota.scan_bytes_per_window,
+            )
+        except Exception as exc:  # noqa: BLE001 — contain: reject, don't crash the pool
+            return AdmissionDecision(
+                admitted=False,
+                code=DQ_REJECTED,
+                reason=f"EXPLAIN failed at admission: {exc}",
+            )
+        cost = report.cost
+        for diag in report.diagnostics:
+            if diag.code == _NEVER_ADMITTABLE_CODE:
+                return AdmissionDecision(
+                    admitted=False,
+                    code=DQ_REJECTED,
+                    reason=f"never admittable: {diag.message}",
+                    cost=cost,
+                )
+
+        # gate 2 — tenant budgets that are knowable before running
+        if pending_count >= quota.max_pending:
+            return AdmissionDecision(
+                admitted=False,
+                code=DQ_QUOTA_EXCEEDED,
+                reason=(
+                    f"tenant {tenant!r} at max_pending="
+                    f"{quota.max_pending} runs"
+                ),
+                cost=cost,
+            )
+        if (
+            quota.state_disk_bytes is not None
+            and state_disk_usage is not None
+            and state_disk_usage > quota.state_disk_bytes
+        ):
+            return AdmissionDecision(
+                admitted=False,
+                code=DQ_QUOTA_EXCEEDED,
+                reason=(
+                    f"tenant {tenant!r} state repository holds "
+                    f"{state_disk_usage} bytes, budget "
+                    f"{quota.state_disk_bytes}"
+                ),
+                cost=cost,
+            )
+
+        # gate 3 — breaker last, so probes aren't wasted on rejects
+        if not self._breakers.allow(tenant, dataset):
+            return AdmissionDecision(
+                admitted=False,
+                code=DQ_BREAKER_OPEN,
+                reason=(
+                    f"circuit breaker open for ({tenant!r}, {dataset!r})"
+                ),
+                cost=cost,
+            )
+
+        tier = cost.admission_tier or "batch"
+        return AdmissionDecision(admitted=True, tier=tier, cost=cost)
+
+
+__all__ = ["AdmissionController", "AdmissionDecision"]

@@ -1,0 +1,121 @@
+"""Tenant quotas and the sliding-window scan ledger.
+
+A tenant's quota bounds three resources:
+
+  * concurrency — how many of its runs may occupy workers at once
+    (``max_concurrent``) and how many may exist in the service at all,
+    running or queued (``max_pending``);
+  * scan bytes — how many predicted-scan bytes it may consume inside a
+    sliding window (``scan_bytes_per_window`` over ``window_s``
+    seconds), charged at admission time and re-charged per partition
+    at run boundaries so a long heavy profile cannot outrun its budget;
+  * state disk — how many bytes its committed partition states may
+    occupy in the state repository (``state_disk_bytes``), checked at
+    admission and at every partition boundary.
+
+The ledger is intentionally a plain sliding window rather than a token
+bucket: charges are timestamped and expire, so a tenant that bursts is
+throttled for exactly one window and then whole again — matching the
+"degrade, don't destroy" posture of the rest of the runtime.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class TenantQuota:
+    """Resource budget for one tenant. ``None`` fields are unmetered."""
+
+    #: runs that may occupy workers simultaneously
+    max_concurrent: int = 2
+    #: runs that may exist in the service at all (running + queued)
+    max_pending: int = 16
+    #: predicted-scan bytes admitted inside one sliding window
+    scan_bytes_per_window: Optional[float] = None
+    #: width of the scan-bytes window, in seconds
+    window_s: float = 60.0
+    #: bytes the tenant's committed states may occupy in the state repo
+    state_disk_bytes: Optional[int] = None
+
+
+DEFAULT_QUOTA = TenantQuota()
+
+
+class QuotaLedger:
+    """Thread-safe per-tenant scan-bytes ledger with a sliding window.
+
+    All clock reads go through the injected ``clock`` so tests (and the
+    chaos harness) can drive time deterministically.
+    """
+
+    def __init__(
+        self,
+        quotas: Optional[Dict[str, TenantQuota]] = None,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._lock = threading.Lock()
+        self._quotas: Dict[str, TenantQuota] = dict(quotas or {})
+        self._clock = clock
+        # tenant -> deque of (charged_at, nbytes); pruned lazily
+        self._charges: Dict[str, Deque[Tuple[float, float]]] = {}
+        # lifetime totals survive window pruning, for telemetry
+        self._totals: Dict[str, float] = {}
+
+    def set_quota(self, tenant: str, quota: TenantQuota) -> None:
+        with self._lock:
+            self._quotas[tenant] = quota
+
+    def quota(self, tenant: str) -> TenantQuota:
+        with self._lock:
+            return self._quotas.get(tenant, DEFAULT_QUOTA)
+
+    def charge_scan(self, tenant: str, nbytes: float) -> None:
+        """Record ``nbytes`` of scan against the tenant's window."""
+        if nbytes <= 0:
+            return
+        now = self._clock()
+        with self._lock:
+            self._charges.setdefault(tenant, deque()).append((now, float(nbytes)))
+            self._totals[tenant] = self._totals.get(tenant, 0.0) + float(nbytes)
+
+    def _prune_locked(self, tenant: str, now: float) -> Deque[Tuple[float, float]]:
+        window = self._quotas.get(tenant, DEFAULT_QUOTA).window_s
+        charges = self._charges.setdefault(tenant, deque())
+        while charges and now - charges[0][0] > window:
+            charges.popleft()
+        return charges
+
+    def bytes_in_window(self, tenant: str) -> float:
+        now = self._clock()
+        with self._lock:
+            return sum(n for _, n in self._prune_locked(tenant, now))
+
+    def scan_headroom(self, tenant: str) -> Optional[float]:
+        """Remaining window budget; negative when overdrawn, None if unmetered."""
+        quota = self.quota(tenant)
+        if quota.scan_bytes_per_window is None:
+            return None
+        return quota.scan_bytes_per_window - self.bytes_in_window(tenant)
+
+    def over_scan_budget(self, tenant: str) -> bool:
+        headroom = self.scan_headroom(tenant)
+        return headroom is not None and headroom < 0
+
+    def bytes_total(self, tenant: str) -> float:
+        with self._lock:
+            return self._totals.get(tenant, 0.0)
+
+    def tenants(self) -> List[str]:
+        with self._lock:
+            seen = set(self._quotas) | set(self._totals)
+            return sorted(seen)
+
+
+__all__ = ["DEFAULT_QUOTA", "QuotaLedger", "TenantQuota"]

@@ -1,0 +1,742 @@
+"""The long-lived DQ service: a bounded worker pool with admission
+control, tiered queues, preemptive scheduling, and graceful drain.
+
+Life of a submission
+--------------------
+``submit()`` EXPLAINs the plan first (admission.py): rejected work
+never touches a worker. Admitted work lands in its tier's bounded
+FIFO (interactive / batch / heavy); a saturated queue sheds by
+priority-then-deadline-slack (DQ412). Workers pop interactive before
+batch before heavy, skipping tenants at their concurrency cap. An
+interactive arrival with no idle worker soft-cancels one running
+heavy run (``RunController.cancel_at_boundary("preempted")``, DQ405):
+the heavy run's in-flight partition still commits its state, the run
+re-queues at the head of its tier, and its retry scans only the
+remaining partitions — bit-identical to an uninterrupted run, because
+partition states are a mergeable semigroup.
+
+Quota enforcement is two-phase: admission rejects what can never fit
+(DQ410/DQ411/DQ413), and a per-partition boundary probe charges
+actual progress against the tenant's sliding scan-bytes window and
+disk budget, stopping overdrawn runs with DQ406 *after* the partition
+commits — the tenant loses headroom, not progress.
+
+Drain (``drain()`` / SIGTERM) stops intake, returns queued work
+DQ414, soft-cancels running work (DQ407 — in-flight partitions
+commit), then joins every worker and the scheduler. ``close()`` is
+idempotent and the service is a context manager.
+"""
+
+from __future__ import annotations
+
+import itertools
+import signal
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
+
+from ..core.controller import RunCancelled, RunController
+from ..ops import runtime
+from ..testing import faults
+from .admission import AdmissionController
+from .breaker import BreakerBoard
+from .codes import DQ_BREAKER_OPEN, DQ_DRAINED, DQ_REJECTED, DQ_SHED
+from .quotas import QuotaLedger, TenantQuota
+from ..observe.heartbeat import publish_event
+from .telemetry import ServiceTelemetry
+from .telemetry import publish as publish_telemetry
+
+TIERS = ("interactive", "batch", "heavy")
+
+DEFAULT_QUEUE_LIMITS = {"interactive": 16, "batch": 16, "heavy": 8}
+
+
+class SubmissionHandle:
+    """The caller's view of a submission: status, code, and result.
+
+    ``status`` moves through submitted -> queued -> running -> one of
+    done | failed | rejected | shed | drained | cancelled | quota.
+    A preempted run goes back to queued; only terminal states set the
+    event ``wait()`` blocks on.
+    """
+
+    def __init__(self, tenant: str, dataset: str) -> None:
+        self.tenant = tenant
+        self.dataset = dataset
+        self.status = "submitted"
+        self.code: Optional[str] = None
+        self.reason = ""
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self.tier: Optional[str] = None
+        self.cost: Any = None
+        self.attempts = 0
+        self.preemptions = 0
+        self._done = threading.Event()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        code = f" {self.code}" if self.code else ""
+        return (
+            f"<SubmissionHandle {self.tenant}/{self.dataset} "
+            f"{self.status}{code}>"
+        )
+
+
+class _Submission:
+    """Internal ledger entry for one unit of queued/running work."""
+
+    __slots__ = (
+        "tenant", "dataset", "data", "checks", "analyzers", "priority",
+        "deadline_s", "submitted_at", "handle", "tier", "cost",
+        "controller", "seq", "counted", "engine",
+    )
+
+    def __init__(
+        self,
+        tenant: str,
+        dataset: str,
+        data: Any,
+        checks: Sequence[Any],
+        analyzers: Sequence[Any],
+        priority: int,
+        deadline_s: Optional[float],
+        submitted_at: float,
+        handle: SubmissionHandle,
+        tier: str,
+        cost: Any,
+        seq: int,
+        engine: str = "single",
+    ) -> None:
+        self.tenant = tenant
+        self.dataset = dataset
+        self.data = data
+        self.checks = list(checks)
+        self.analyzers = list(analyzers)
+        self.priority = priority
+        self.deadline_s = deadline_s
+        self.submitted_at = submitted_at
+        self.handle = handle
+        self.tier = tier
+        self.cost = cost
+        self.controller: Optional[RunController] = None
+        self.seq = seq
+        self.engine = engine
+        # whether this submission currently counts against the
+        # tenant's pending budget (decremented exactly once)
+        self.counted = True
+
+
+class DQService:
+    """A fleet-scale data-quality service over a bounded worker pool."""
+
+    def __init__(
+        self,
+        workers: Optional[int] = None,
+        *,
+        state_repository: Any = None,
+        metrics_repository: Any = None,
+        quotas: Optional[Dict[str, TenantQuota]] = None,
+        queue_limits: Optional[Dict[str, int]] = None,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        tick_s: float = 0.05,
+        name: str = "dq",
+    ) -> None:
+        self._workers_n = workers if workers is not None else runtime.service_workers()
+        self._state_repository = state_repository
+        self._metrics_repository = metrics_repository
+        self._clock = clock
+        self._tick_s = float(tick_s)
+        self._name = name
+
+        self.ledger = QuotaLedger(quotas, clock=clock)
+        self.breakers = BreakerBoard(
+            threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s,
+            clock=clock,
+        )
+        self.telemetry = ServiceTelemetry()
+        self._admission = AdmissionController(self.ledger, self.breakers)
+
+        self._cv = threading.Condition()
+        self._queues: Dict[str, Deque[_Submission]] = {t: deque() for t in TIERS}
+        self._queue_limits = dict(DEFAULT_QUEUE_LIMITS)
+        if queue_limits:
+            self._queue_limits.update(queue_limits)
+        self._running: List[_Submission] = []
+        self._pending: Dict[str, int] = {}
+        self._seq = itertools.count()
+        self._accepting = True
+        self._stopping = False
+        self._stop_event = threading.Event()
+        self._prev_sigterm: Any = None
+
+        self._threads: List[threading.Thread] = []
+        for i in range(self._workers_n):
+            t = threading.Thread(
+                target=self._worker_loop,
+                name=f"deequ-{name}-service-worker-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        self._scheduler = threading.Thread(
+            target=self._scheduler_loop,
+            name=f"deequ-{name}-service-scheduler",
+            daemon=True,
+        )
+        self._scheduler.start()
+
+    # ------------------------------------------------------------------
+    # intake
+
+    def submit(
+        self,
+        tenant: str,
+        dataset: str,
+        data: Any,
+        *,
+        checks: Sequence[Any] = (),
+        analyzers: Sequence[Any] = (),
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        engine: str = "single",
+    ) -> SubmissionHandle:
+        """Submit a suite; returns immediately with a handle.
+
+        ``data`` is the table to verify, or a zero-arg callable
+        returning it (a factory defers any expensive open to the
+        worker; admission then costs the plan from the factory's
+        result, so prefer cheap lazily-opening tables).
+        """
+        handle = SubmissionHandle(tenant, dataset)
+        handle.attempts = 0
+        self.telemetry.count("submitted")
+
+        with self._cv:
+            if not self._accepting:
+                return self._finalize_locked_handle(
+                    handle, "drained", DQ_DRAINED,
+                    "service is draining; resubmit after restart",
+                )
+            pending = self._pending.get(tenant, 0)
+
+        # fail fast on an open breaker before the factory runs: no
+        # per-submission work for a fenced-off (tenant, dataset), and
+        # no half-open probe consumed (open_now is a pure read)
+        if self.breakers.open_now(tenant, dataset):
+            self.telemetry.count("rejected")
+            return self._finalize_locked_handle(
+                handle, "rejected", DQ_BREAKER_OPEN,
+                f"circuit breaker open for ({tenant!r}, {dataset!r})",
+            )
+
+        try:
+            table = data() if callable(data) else data
+        except Exception as exc:  # noqa: BLE001 — containment: a bad
+            # dataset factory (corrupt file, dead mount) is the
+            # dataset's failure, not the service's: feed the breaker
+            self.breakers.record_failure(tenant, dataset)
+            self.telemetry.count("failed")
+            handle.error = exc
+            return self._finalize_locked_handle(
+                handle, "failed", None,
+                f"dataset open failed: {type(exc).__name__}: {exc}",
+            )
+        try:
+            decision = self._admission.evaluate(
+                tenant,
+                dataset,
+                table,
+                checks,
+                analyzers,
+                pending_count=pending,
+                state_disk_usage=self._state_disk_usage(tenant, dataset),
+            )
+        except faults.InjectedFaultError as exc:
+            # containment: admission bookkeeping died, the pool did not
+            self.telemetry.count("admission_faults")
+            return self._finalize_locked_handle(
+                handle, "rejected", DQ_REJECTED,
+                f"admission unavailable: {exc}",
+            )
+        if not decision.admitted:
+            self.telemetry.count("rejected")
+            handle.cost = decision.cost
+            status = "rejected"
+            publish_event(
+                "service.rejected",
+                tenant=tenant, dataset=dataset, code=decision.code,
+            )
+            return self._finalize_locked_handle(
+                handle, status, decision.code, decision.reason,
+            )
+
+        self.telemetry.count("admitted")
+        tier = decision.tier or "batch"
+        handle.tier = tier
+        handle.cost = decision.cost
+        sub = _Submission(
+            tenant, dataset, data, checks, analyzers, priority,
+            deadline_s, self._clock(), handle, tier, decision.cost,
+            next(self._seq), engine,
+        )
+        with self._cv:
+            if not self._accepting:
+                return self._finalize_locked_handle(
+                    handle, "drained", DQ_DRAINED,
+                    "service began draining during admission",
+                )
+            if not self._enqueue_locked(sub):
+                return handle  # shed; handle already finalized
+            self._pending[tenant] = self._pending.get(tenant, 0) + 1
+            handle.status = "queued"
+            if tier == "interactive":
+                self._maybe_preempt_locked()
+            self._cv.notify_all()
+        return handle
+
+    def _enqueue_locked(self, sub: _Submission) -> bool:
+        """FIFO enqueue with shed-on-overload. Returns False when the
+        new submission itself was shed."""
+        q = self._queues[sub.tier]
+        if len(q) < self._queue_limits[sub.tier]:
+            q.append(sub)
+            return True
+        # saturated: compare against the worst queued item by
+        # (priority, deadline slack) — bigger key = more worth keeping
+        now = self._clock()
+
+        def keep_key(s: _Submission) -> Tuple[int, float]:
+            if s.deadline_s is None:
+                slack = float("inf")
+            else:
+                slack = (s.submitted_at + s.deadline_s) - now
+            # prefer high priority; break ties by LESS slack (closer
+            # deadline = more urgent = more worth keeping)
+            return (s.priority, -slack)
+
+        worst = min(q, key=keep_key)
+        if keep_key(sub) > keep_key(worst):
+            q.remove(worst)
+            self._shed_locked(worst, "displaced by higher-priority work")
+            q.append(sub)
+            return True
+        self.telemetry.count("shed")
+        self._finalize_locked_handle(
+            sub.handle, "shed", DQ_SHED,
+            f"{sub.tier} queue saturated "
+            f"({self._queue_limits[sub.tier]} deep)",
+        )
+        return False
+
+    def _shed_locked(self, sub: _Submission, why: str) -> None:
+        self.telemetry.count("shed")
+        self._decrement_pending_locked(sub)
+        publish_event(
+            "service.shed", tenant=sub.tenant, dataset=sub.dataset, why=why,
+        )
+        self._finalize_locked_handle(sub.handle, "shed", DQ_SHED, why)
+
+    def _finalize_locked_handle(
+        self,
+        handle: SubmissionHandle,
+        status: str,
+        code: Optional[str],
+        reason: str,
+    ) -> SubmissionHandle:
+        handle.status = status
+        handle.code = code
+        handle.reason = reason
+        handle._done.set()
+        return handle
+
+    def _decrement_pending_locked(self, sub: _Submission) -> None:
+        if not sub.counted:
+            return
+        sub.counted = False
+        n = self._pending.get(sub.tenant, 0)
+        if n <= 1:
+            self._pending.pop(sub.tenant, None)
+        else:
+            self._pending[sub.tenant] = n - 1
+
+    def _state_disk_usage(self, tenant: str, dataset: str) -> Optional[int]:
+        if self._state_repository is None:
+            return None
+        try:
+            return self._state_repository.disk_usage(self._state_dataset(tenant, dataset))
+        except OSError:  # fault-ok: unknowable usage never blocks admission
+            return None
+
+    @staticmethod
+    def _state_dataset(tenant: str, dataset: str) -> str:
+        return f"{tenant}/{dataset}"
+
+    # ------------------------------------------------------------------
+    # scheduling
+
+    def _pop_next_locked(self) -> Optional[_Submission]:
+        for tier in TIERS:
+            q = self._queues[tier]
+            if not q:
+                continue
+            # highest priority first, FIFO within a priority; skip
+            # tenants at their concurrency cap
+            best: Optional[_Submission] = None
+            for s in q:
+                if self._tenant_running_locked(s.tenant) >= self.ledger.quota(
+                    s.tenant
+                ).max_concurrent:
+                    continue
+                if best is None or (s.priority, -s.seq) > (best.priority, -best.seq):
+                    best = s
+            if best is None:
+                continue
+            faults.fault_point("service.queue")
+            q.remove(best)
+            return best
+        return None
+
+    def _tenant_running_locked(self, tenant: str) -> int:
+        return sum(1 for s in self._running if s.tenant == tenant)
+
+    def _maybe_preempt_locked(self) -> None:
+        """An interactive arrival with no idle worker bumps one
+        running heavy run (soft cancel — its partition commits)."""
+        if not self._queues["interactive"]:
+            return
+        if len(self._running) < self._workers_n:
+            return  # an idle worker will pick it up
+        for s in self._running:
+            if s.tier != "heavy" or s.controller is None:
+                continue
+            if s.controller.soft_cancelled or s.controller.cancelled:
+                continue
+            s.controller.cancel_at_boundary("preempted")
+            publish_event(
+                "service.preempt", tenant=s.tenant, dataset=s.dataset,
+            )
+            return
+
+    def _scheduler_loop(self) -> None:
+        while not self._stop_event.wait(self._tick_s):
+            try:
+                faults.fault_point("service.scheduler")
+            except OSError:  # fault-ok: a raise-kind override loses one tick
+                continue
+            with self._cv:
+                self._expire_queued_locked()
+                self._maybe_preempt_locked()
+                self._cv.notify_all()
+
+    def _expire_queued_locked(self) -> None:
+        now = self._clock()
+        for tier in TIERS:
+            q = self._queues[tier]
+            expired = [
+                s for s in q
+                if s.deadline_s is not None
+                and (s.submitted_at + s.deadline_s) <= now
+            ]
+            for s in expired:
+                q.remove(s)
+                self._shed_locked(s, "deadline expired while queued")
+
+    # ------------------------------------------------------------------
+    # workers
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cv:
+                sub = None
+                while sub is None:
+                    if self._stopping:
+                        return
+                    try:
+                        sub = self._pop_next_locked()
+                    except faults.InjectedFaultError:
+                        # containment: the pop failed, the queue item
+                        # stays put; count it and retry on next wake
+                        self.telemetry.count("queue_faults")
+                        sub = None
+                    if sub is None:
+                        self._cv.wait(timeout=0.1)
+                self._running.append(sub)
+                sub.handle.status = "running"
+            try:
+                self._execute(sub)
+            finally:
+                with self._cv:
+                    if sub in self._running:
+                        self._running.remove(sub)
+                    self._cv.notify_all()
+
+    def _execute(self, sub: _Submission) -> None:
+        # local import: verification pulls the whole runner stack;
+        # keep service import light for tools that only want codes
+        from ..verification.suite import VerificationSuite
+
+        handle = sub.handle
+        handle.attempts += 1
+        remaining: Optional[float] = None
+        if sub.deadline_s is not None:
+            remaining = (sub.submitted_at + sub.deadline_s) - self._clock()
+            if remaining <= 0:
+                with self._cv:
+                    self._shed_locked(sub, "deadline expired before start")
+                return
+        ctl = RunController(deadline_s=remaining)
+        ctl.set_boundary_probe(self._boundary_probe(sub))
+        with self._cv:
+            sub.controller = ctl
+        try:
+            faults.fault_point("service.worker")
+            table = sub.data() if callable(sub.data) else sub.data
+            builder = VerificationSuite().on_data(table).with_controller(ctl)
+            for check in sub.checks:
+                builder = builder.add_check(check)
+            for analyzer in sub.analyzers:
+                builder = builder.add_required_analyzer(analyzer)
+            builder = builder.with_engine(sub.engine)
+            if self._state_repository is not None:
+                builder = builder.with_state_repository(
+                    self._state_repository,
+                    dataset=self._state_dataset(sub.tenant, sub.dataset),
+                )
+            result = builder.run()
+        except RunCancelled as exc:
+            self._on_cancelled(sub, exc)
+            return
+        except Exception as exc:  # noqa: BLE001 — containment: one bad
+            # run (chaos fault, corrupt dataset, kernel error) must not
+            # take the worker thread or the pool down with it
+            self.breakers.record_failure(sub.tenant, sub.dataset)
+            self.telemetry.count("failed")
+            if isinstance(exc, faults.InjectedFaultError):
+                self.telemetry.count("worker_faults")
+            handle.error = exc
+            with self._cv:
+                self._decrement_pending_locked(sub)
+                self._finalize_locked_handle(
+                    handle, "failed", None, f"{type(exc).__name__}: {exc}",
+                )
+            publish_event(
+                "service.failed", tenant=sub.tenant, dataset=sub.dataset,
+            )
+            return
+        self.breakers.record_success(sub.tenant, sub.dataset)
+        self.telemetry.count("completed")
+        handle.result = result
+        with self._cv:
+            self._decrement_pending_locked(sub)
+            self._finalize_locked_handle(handle, "done", None, "")
+
+    def _on_cancelled(self, sub: _Submission, exc: RunCancelled) -> None:
+        handle = sub.handle
+        if exc.reason == "preempted":
+            # neutral for the breaker: the run said nothing about the
+            # dataset; re-queue at the HEAD so it resumes next
+            self.breakers.record_neutral(sub.tenant, sub.dataset)
+            self.telemetry.count("preempted")
+            handle.preemptions += 1
+            with self._cv:
+                if self._accepting and not self._stopping:
+                    sub.controller = None
+                    handle.status = "queued"
+                    self._queues[sub.tier].appendleft(sub)
+                    self._cv.notify_all()
+                    return
+                # preempted during drain: treat as drained
+                self._decrement_pending_locked(sub)
+                self._finalize_locked_handle(
+                    handle, "drained", DQ_DRAINED,
+                    "preempted while service drained; committed partition "
+                    "states resume the run after restart",
+                )
+            return
+        if exc.reason == "quota":
+            self.breakers.record_neutral(sub.tenant, sub.dataset)
+            self.telemetry.count("quota_stops")
+            status = "quota"
+        elif exc.reason == "drain":
+            self.breakers.record_neutral(sub.tenant, sub.dataset)
+            self.telemetry.count("drained")
+            status = "drained"
+        else:
+            self.breakers.record_neutral(sub.tenant, sub.dataset)
+            status = "cancelled"
+        handle.error = exc
+        with self._cv:
+            self._decrement_pending_locked(sub)
+            self._finalize_locked_handle(handle, status, exc.code, str(exc))
+        publish_event(
+            "service." + status, tenant=sub.tenant, dataset=sub.dataset,
+            code=exc.code,
+        )
+
+    def _boundary_probe(
+        self, sub: _Submission
+    ) -> Callable[[Dict[str, Any]], Optional[str]]:
+        """Per-partition quota enforcement. Charges the tenant's
+        sliding window for each newly committed partition (estimated
+        from the EXPLAIN cost split evenly across partitions) and
+        stops the run with DQ406 once overdrawn — after the partition
+        committed, so progress is never lost."""
+        cost = sub.cost
+        predicted = 0.0
+        if cost is not None and cost.predicted_scan_bytes is not None:
+            predicted = float(cost.predicted_scan_bytes)
+        charged = {"parts": 0}
+
+        def probe(progress: Dict[str, Any]) -> Optional[str]:
+            done = int(progress.get("partitions_done", 0))
+            # charge only SCANNED partitions — cache hits read no data,
+            # so they never count against the tenant's scan window
+            scanned = done - int(progress.get("partitions_cached", 0))
+            # the static plan may not know the partition split; the
+            # runtime progress dict always does on partitioned runs
+            total = int(progress.get("partitions_total", 0)) or 1
+            per_part = predicted / total
+            new = scanned - charged["parts"]
+            if new > 0 and per_part > 0:
+                charge = new * per_part
+                self.ledger.charge_scan(sub.tenant, charge)
+                self.telemetry.charge_tenant_bytes(sub.tenant, charge)
+                charged["parts"] = scanned
+            if self.ledger.over_scan_budget(sub.tenant):
+                return "quota"
+            quota = self.ledger.quota(sub.tenant)
+            if quota.state_disk_bytes is not None:
+                usage = self._state_disk_usage(sub.tenant, sub.dataset)
+                if usage is not None and usage > quota.state_disk_bytes:
+                    return "quota"
+            return None
+
+        return probe
+
+    # ------------------------------------------------------------------
+    # introspection & telemetry
+
+    def queue_depths(self) -> Dict[str, int]:
+        with self._cv:
+            return {tier: len(q) for tier, q in self._queues.items()}
+
+    def running_count(self) -> int:
+        with self._cv:
+            return len(self._running)
+
+    def telemetry_snapshot(self) -> Dict[str, float]:
+        depths = self.queue_depths()
+        return self.telemetry.snapshot(
+            queue_depths=depths,
+            running=self.running_count(),
+            workers=self._workers_n,
+            breaker_open=self.breakers.open_count(),
+            breaker_transitions=self.breakers.transitions(),
+        )
+
+    def publish_telemetry(self) -> Optional[Dict[str, float]]:
+        if self._metrics_repository is None:
+            return None
+        record = self.telemetry_snapshot()
+        publish_telemetry(self._metrics_repository, record)
+        return record
+
+    # ------------------------------------------------------------------
+    # drain & shutdown
+
+    def drain(self, timeout_s: Optional[float] = None) -> None:
+        """Graceful shutdown: stop intake, return queued work DQ414,
+        soft-cancel running work so in-flight partitions commit, then
+        join every thread. Escalates to hard cancel at the timeout."""
+        if timeout_s is None:
+            timeout_s = runtime.service_drain_s()
+        with self._cv:
+            already = self._stopping and not self._accepting
+            self._accepting = False
+            queued: List[_Submission] = []
+            for tier in TIERS:
+                q = self._queues[tier]
+                queued.extend(q)
+                q.clear()
+            for sub in queued:
+                self.telemetry.count("drained")
+                self._decrement_pending_locked(sub)
+                self._finalize_locked_handle(
+                    sub.handle, "drained", DQ_DRAINED,
+                    "returned unrun by graceful drain; committed partition "
+                    "states resume the run after restart",
+                )
+            for sub in self._running:
+                if sub.controller is not None:
+                    sub.controller.cancel_at_boundary("drain")
+            self._cv.notify_all()
+        if already:
+            return
+        publish_event("service.drain", name=self._name, queued=len(queued))
+        deadline = self._clock() + float(timeout_s)
+        with self._cv:
+            while self._running and self._clock() < deadline:
+                self._cv.wait(timeout=0.1)
+            # past the timeout: escalate soft to hard cancel
+            for sub in self._running:
+                if sub.controller is not None:
+                    sub.controller.cancel("drain")
+            while self._running:
+                self._cv.wait(timeout=0.1)
+        try:
+            self.publish_telemetry()
+        except Exception:  # fault-ok: telemetry must not block drain
+            pass
+        self._shutdown_threads()
+
+    def _shutdown_threads(self) -> None:
+        self._stop_event.set()
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=10.0)
+        self._scheduler.join(timeout=10.0)
+
+    def close(self) -> None:
+        self.drain()
+
+    def __enter__(self) -> "DQService":
+        return self
+
+    def __exit__(self, *exc_info: Any) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------
+    # SIGTERM integration
+
+    def install_sigterm(self) -> None:
+        """Drain on SIGTERM. The handler does not exit the process —
+        the host decides what happens after the pool is quiet."""
+        def _handler(signum: int, frame: Any) -> None:
+            self.drain()
+
+        self._prev_sigterm = signal.signal(signal.SIGTERM, _handler)
+
+    def uninstall_sigterm(self) -> None:
+        if self._prev_sigterm is not None:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._prev_sigterm = None
+
+
+__all__ = [
+    "DEFAULT_QUEUE_LIMITS",
+    "TIERS",
+    "DQService",
+    "SubmissionHandle",
+]

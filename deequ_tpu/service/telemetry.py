@@ -1,0 +1,112 @@
+"""Fleet telemetry for the DQ service.
+
+Counters are plain locked integers — the service's hot paths touch
+them under their own locks already, so the cost here is one more
+uncontended acquire. ``snapshot()`` flattens everything into the
+``engine.service.*`` float namespace so the existing ``EngineMetric``
+repository machinery (and the sentinel's watched series) persist and
+trend service health exactly like any other engine metric.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Mapping, Optional
+
+from ..repository.engine import engine_result_key, persist_engine_record
+
+PREFIX = "engine.service."
+
+#: counter names every snapshot carries, even at zero
+COUNTERS = (
+    "submitted",
+    "admitted",
+    "rejected",
+    "shed",
+    "preempted",
+    "drained",
+    "quota_stops",
+    "completed",
+    "failed",
+    "queue_faults",
+    "worker_faults",
+    "admission_faults",
+)
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_" else "_" for c in name)
+
+
+class ServiceTelemetry:
+    """Thread-safe counters + per-tenant scan-bytes accumulators."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {name: 0 for name in COUNTERS}
+        self._tenant_bytes: Dict[str, float] = {}
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def value(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def charge_tenant_bytes(self, tenant: str, nbytes: float) -> None:
+        if nbytes <= 0:
+            return
+        with self._lock:
+            self._tenant_bytes[tenant] = (
+                self._tenant_bytes.get(tenant, 0.0) + float(nbytes)
+            )
+
+    def snapshot(
+        self,
+        *,
+        queue_depths: Mapping[str, int],
+        running: int,
+        workers: int,
+        breaker_open: int,
+        breaker_transitions: int,
+    ) -> Dict[str, float]:
+        """One flat ``engine.service.*`` record, ready to persist."""
+        with self._lock:
+            counts = dict(self._counts)
+            tenant_bytes = dict(self._tenant_bytes)
+        record: Dict[str, float] = {}
+        for name, value in counts.items():
+            record[PREFIX + name] = float(value)
+        for tier, depth in queue_depths.items():
+            record[PREFIX + f"queue_depth.{tier}"] = float(depth)
+        record[PREFIX + "running"] = float(running)
+        record[PREFIX + "workers"] = float(workers)
+        record[PREFIX + "breaker_open"] = float(breaker_open)
+        record[PREFIX + "breaker_transitions"] = float(breaker_transitions)
+        submitted = counts.get("submitted", 0)
+        if submitted > 0:
+            record[PREFIX + "shed_ratio"] = counts.get("shed", 0) / submitted
+        for tenant, nbytes in tenant_bytes.items():
+            record[PREFIX + f"tenant.{_sanitize(tenant)}.bytes_scanned"] = nbytes
+        return record
+
+
+def publish(
+    repository: Any,
+    record: Dict[str, float],
+    *,
+    suite: str = "service",
+    dataset: str = "fleet",
+    tags: Optional[Dict[str, str]] = None,
+) -> None:
+    """Persist one service snapshot through the EngineMetric repository."""
+    key = engine_result_key(
+        suite=suite,
+        dataset=dataset,
+        tags=dict(tags or {"component": "service"}),
+    )
+    persist_engine_record(repository, record, key, instance="service")
+
+
+__all__ = ["COUNTERS", "PREFIX", "ServiceTelemetry", "publish"]

@@ -82,6 +82,11 @@ FAULT_KINDS: Dict[str, str] = {
     # state repository (repository/states.py)
     "state.save": "raise",     # the per-partition state commit fails
     "state.load": "raise",     # a cached-state read fails
+    # DQ service (service/): the fleet-scale execution layer
+    "service.worker": "raise",     # a pool worker dies executing a run
+    "service.scheduler": "sleep",  # the scheduler housekeeping tick wedges
+    "service.admission": "raise",  # admission bookkeeping fails mid-submit
+    "service.queue": "raise",      # a tier-queue pop fails (corruption)
 }
 
 FAULT_POINTS = frozenset(FAULT_KINDS)

@@ -309,6 +309,63 @@ def test_injected_stage_fault_contained_in_staged():
     assert _wait_no_threads("deequ-pipe-t-chaos")
 
 
+def test_service_drain_on_sigterm_joins_all_and_closes_all(parquet_path):
+    """SIGTERM drains the DQ service through the same shutdown contract
+    as the pipeline: queued work is returned with DQ414, the running
+    run either commits or is drained at a boundary, EVERY service /
+    pipeline / decode thread joins, and no parquet fd stays open."""
+    import signal
+
+    from deequ_tpu.service import DQ_DRAINED, DQService
+
+    gate = threading.Event()
+
+    def slow_data():
+        gate.wait(timeout=30)
+        return ParquetSource(parquet_path, batch_rows=10_000)
+
+    svc = DQService(workers=1)
+    svc.install_sigterm()
+    try:
+        from deequ_tpu import Check, CheckLevel
+
+        check = Check(CheckLevel.ERROR, "drain").has_size(lambda s: s > 0)
+        running = svc.submit("t", "d0", slow_data, checks=[check])
+        for _ in range(300):
+            if running.status == "running":
+                break
+            time.sleep(0.01)
+        queued = svc.submit("t", "d1", slow_data, checks=[check])
+        gate.set()
+
+        # deliver a real SIGTERM to this process; the installed handler
+        # runs svc.drain() synchronously in the main thread
+        os.kill(os.getpid(), signal.SIGTERM)
+
+        assert queued.done()
+        assert queued.status == "drained" and queued.code == DQ_DRAINED
+        assert running.done()
+        # the in-flight run either finished cleanly before the drain's
+        # soft cancel reached a boundary, or was drained — never killed
+        # into an undefined state
+        assert running.status in ("done", "drained")
+    finally:
+        svc.uninstall_sigterm()
+        gate.set()
+        svc.close()
+
+    assert _wait_no_threads("deequ-dq-service"), "service threads leaked"
+    assert _wait_no_threads("deequ-pipe"), "pipeline threads leaked"
+    assert _wait_no_threads("deequ-decode"), "decode threads leaked"
+    targets = _open_fd_targets()
+    if targets is not None:
+        assert parquet_path not in targets, "parquet fd leaked past drain"
+
+    # post-drain submissions are turned away with the drain code
+    late = svc.submit("t", "d2", slow_data, checks=[])
+    assert late.done() and late.code == DQ_DRAINED
+
+
 def test_cancellation_joins_all_stages(parquet_path):
     """RunCancelled raised in the consumer loop (the fold-side
     controller check) unwinds the stacked staged-over-batches shape

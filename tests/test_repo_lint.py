@@ -768,3 +768,30 @@ def test_faults_rule_covers_stage_worker_and_readahead_files():
     assert f"deequ_tpu{sep}data{sep}native_reader.py" in rels
     for rel in rels:
         assert os.path.exists(os.path.join(REPO, rel)), rel
+
+
+def test_faults_rule_covers_service_files():
+    """The DQ service files carry multi-tenant blast radius: the
+    containment rule must audit them, and the chaos registry must carry
+    the service.* points their fault_point() literals name."""
+    lint = _lint_module()
+    sep = os.sep
+    rels = set(lint.FAULTS_FILES)
+    assert f"deequ_tpu{sep}service{sep}service.py" in rels
+    assert f"deequ_tpu{sep}service{sep}admission.py" in rels
+    assert f"deequ_tpu{sep}service{sep}breaker.py" in rels
+
+    registered = lint._registered_fault_points()
+    for point in (
+        "service.worker",
+        "service.scheduler",
+        "service.admission",
+        "service.queue",
+    ):
+        assert point in registered, point
+
+    # and the audited files must actually be clean today
+    for rel in rels:
+        path = os.path.join(REPO, rel)
+        assert lint.check_fault_containment(path) == [], rel
+        assert lint.check_fault_registration(path, registered) == [], rel

@@ -1,0 +1,642 @@
+"""The fleet-scale DQ service (deequ_tpu/service/): admission control,
+tenant quotas, circuit breakers, preemptive scheduling, and the
+preempt→resume bit-identity contract.
+
+The load-bearing guarantee is the last one: a heavy partitioned run
+preempted by interactive work (DQ405 at a partition boundary) must,
+when it resumes, merge its committed partition states with a scan of
+only the remainder and produce a result BIT-identical to an
+uninterrupted run — on both placements. Everything else (queues,
+sheds, quotas) is scheduling policy around that invariant.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deequ_tpu import Check, CheckLevel, CheckStatus, VerificationSuite
+from deequ_tpu.core.controller import (
+    DQ_DRAIN,
+    DQ_PREEMPTED,
+    DQ_QUOTA,
+    RunCancelled,
+    RunController,
+)
+from deequ_tpu.data.table import Table
+from deequ_tpu.lint.explain import explain_plan
+from deequ_tpu.repository import InMemoryMetricsRepository
+from deequ_tpu.repository.engine import engine_series
+from deequ_tpu.repository.states import FileSystemStateRepository
+from deequ_tpu.service import (
+    DQ_BREAKER_OPEN,
+    DQ_DRAINED,
+    DQ_QUOTA_EXCEEDED,
+    DQ_REJECTED,
+    DQ_SHED,
+    BreakerBoard,
+    DQService,
+    QuotaLedger,
+    TenantQuota,
+)
+from deequ_tpu.service.admission import AdmissionController
+
+from test_suite_differential_fuzz import (
+    _write_partition,
+    random_check,
+    random_table,
+    suite_snapshot,
+)
+
+
+def _small_table() -> Table:
+    return Table.from_pydict(
+        {
+            "item": ["1", "2", "3", "4"],
+            "att1": ["a", "a", "a", "b"],
+        }
+    )
+
+
+def _basic_check() -> Check:
+    return Check(CheckLevel.ERROR, "basic").is_complete("item")
+
+
+# ---------------------------------------------------------------------------
+# quotas: the sliding scan-bytes ledger
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def test_quota_ledger_sliding_window():
+    clock = FakeClock()
+    ledger = QuotaLedger(
+        {"acme": TenantQuota(scan_bytes_per_window=100.0, window_s=10.0)},
+        clock=clock,
+    )
+    ledger.charge_scan("acme", 60.0)
+    assert ledger.bytes_in_window("acme") == 60.0
+    assert ledger.scan_headroom("acme") == 40.0
+    assert not ledger.over_scan_budget("acme")
+
+    ledger.charge_scan("acme", 60.0)
+    assert ledger.scan_headroom("acme") == -20.0
+    assert ledger.over_scan_budget("acme")
+
+    # the window slides: old charges expire and the tenant is whole
+    clock.advance(11.0)
+    assert ledger.bytes_in_window("acme") == 0.0
+    assert not ledger.over_scan_budget("acme")
+    # lifetime totals survive the pruning (telemetry)
+    assert ledger.bytes_total("acme") == 120.0
+
+
+def test_quota_ledger_unmetered_tenant_has_no_headroom_concept():
+    ledger = QuotaLedger()
+    ledger.charge_scan("anon", 1e12)
+    assert ledger.scan_headroom("anon") is None
+    assert not ledger.over_scan_budget("anon")
+
+
+# ---------------------------------------------------------------------------
+# circuit breakers
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_trips_after_threshold_and_cools_down():
+    clock = FakeClock()
+    board = BreakerBoard(threshold=3, cooldown_s=30.0, clock=clock)
+    pair = ("acme", "orders")
+
+    for _ in range(2):
+        board.record_failure(*pair)
+        assert board.allow(*pair)  # still closed below threshold
+    board.record_failure(*pair)
+    assert board.state(*pair) == "open"
+    assert not board.allow(*pair)
+    assert board.open_count() == 1
+
+    # cooldown elapses -> half-open grants exactly ONE probe
+    clock.advance(31.0)
+    assert board.allow(*pair)
+    assert board.state(*pair) == "half_open"
+    assert not board.allow(*pair)  # second caller: probe slot taken
+
+    # probe succeeds -> closed, failures reset
+    board.record_success(*pair)
+    assert board.state(*pair) == "closed"
+    assert board.allow(*pair)
+
+
+def test_breaker_half_open_failure_reopens_with_fresh_cooldown():
+    clock = FakeClock()
+    board = BreakerBoard(threshold=1, cooldown_s=10.0, clock=clock)
+    board.record_failure("t", "d")
+    clock.advance(11.0)
+    assert board.allow("t", "d")
+    board.record_failure("t", "d")  # probe failed
+    assert board.state("t", "d") == "open"
+    clock.advance(5.0)
+    assert not board.allow("t", "d")  # fresh cooldown, not the old one
+    clock.advance(6.0)
+    assert board.allow("t", "d")
+
+
+def test_breaker_neutral_probe_releases_slot_stays_half_open():
+    clock = FakeClock()
+    board = BreakerBoard(threshold=1, cooldown_s=10.0, clock=clock)
+    board.record_failure("t", "d")
+    clock.advance(11.0)
+    assert board.allow("t", "d")
+    # the probe was preempted/drained: says nothing about health
+    board.record_neutral("t", "d")
+    assert board.state("t", "d") == "half_open"
+    assert board.allow("t", "d")  # next submission probes again
+
+
+def test_breaker_isolates_pairs():
+    board = BreakerBoard(threshold=1)
+    board.record_failure("acme", "orders")
+    assert not board.allow("acme", "orders")
+    assert board.allow("acme", "payments")
+    assert board.allow("globex", "orders")
+
+
+# ---------------------------------------------------------------------------
+# admission control: EXPLAIN-first gates
+# ---------------------------------------------------------------------------
+
+
+def _admission(quotas=None):
+    ledger = QuotaLedger(quotas)
+    board = BreakerBoard(threshold=1)
+    return AdmissionController(ledger, board), ledger, board
+
+
+def test_admission_admits_small_plan_as_interactive():
+    ctl, _, _ = _admission()
+    d = ctl.evaluate(
+        "acme", "orders", _small_table(), [_basic_check()], [],
+        pending_count=0,
+    )
+    assert d.admitted
+    assert d.tier == "interactive"
+    assert d.cost is not None and d.cost.admission_tier == "interactive"
+
+
+def test_admission_rejects_never_admittable_plan_dq410():
+    """A plan predicting more scan than the tenant's whole window is
+    DQ319 at EXPLAIN and DQ410 at admission — it never reaches a
+    worker, today or ever."""
+    ctl, _, _ = _admission(
+        {"acme": TenantQuota(scan_bytes_per_window=1.0, window_s=60.0)}
+    )
+    d = ctl.evaluate(
+        "acme", "orders", _small_table(), [_basic_check()], [],
+        pending_count=0,
+    )
+    assert not d.admitted
+    assert d.code == DQ_REJECTED
+    assert "never admittable" in d.reason
+
+
+def test_admission_rejects_at_max_pending_dq411():
+    ctl, _, _ = _admission({"acme": TenantQuota(max_pending=2)})
+    d = ctl.evaluate(
+        "acme", "orders", _small_table(), [_basic_check()], [],
+        pending_count=2,
+    )
+    assert not d.admitted
+    assert d.code == DQ_QUOTA_EXCEEDED
+
+
+def test_admission_rejects_blown_state_disk_budget_dq411():
+    ctl, _, _ = _admission({"acme": TenantQuota(state_disk_bytes=100)})
+    d = ctl.evaluate(
+        "acme", "orders", _small_table(), [_basic_check()], [],
+        pending_count=0, state_disk_usage=101,
+    )
+    assert not d.admitted
+    assert d.code == DQ_QUOTA_EXCEEDED
+
+
+def test_admission_breaker_open_dq413_and_checked_last():
+    ctl, _, board = _admission({"acme": TenantQuota(max_pending=1)})
+    board.record_failure("acme", "orders")
+
+    d = ctl.evaluate(
+        "acme", "orders", _small_table(), [_basic_check()], [],
+        pending_count=0,
+    )
+    assert not d.admitted and d.code == DQ_BREAKER_OPEN
+
+    # breaker runs LAST: a quota-rejected submission must not consume
+    # the half-open probe slot
+    board2 = BreakerBoard(threshold=1, cooldown_s=0.0)
+    ctl2 = AdmissionController(
+        QuotaLedger({"acme": TenantQuota(max_pending=1)}), board2
+    )
+    board2.record_failure("acme", "orders")
+    time.sleep(0.01)  # past the zero cooldown -> half-open on next allow
+    d = ctl2.evaluate(
+        "acme", "orders", _small_table(), [_basic_check()], [],
+        pending_count=1,  # quota-rejected before the breaker is asked
+    )
+    assert d.code == DQ_QUOTA_EXCEEDED
+    assert board2.allow("acme", "orders")  # probe slot still available
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN: the admission line and the DQ319 lint
+# ---------------------------------------------------------------------------
+
+
+def test_explain_renders_admission_line_with_headroom():
+    report = explain_plan(
+        _small_table(), checks=[_basic_check()], quota_scan_bytes=1 << 20
+    )
+    text = report.render()
+    assert "admission: tier=interactive" in text
+    assert "quota headroom" in text
+    assert not any(d.code == "DQ319" for d in report.diagnostics)
+
+
+def test_explain_dq319_when_plan_exceeds_quota_window():
+    report = explain_plan(
+        _small_table(), checks=[_basic_check()], quota_scan_bytes=1.0
+    )
+    codes = [d.code for d in report.diagnostics]
+    assert "DQ319" in codes
+    assert "quota overdrawn" in report.render()
+
+
+def test_cost_tier_thresholds(monkeypatch):
+    from deequ_tpu.lint import cost as cost_mod
+
+    report = explain_plan(_small_table(), checks=[_basic_check()])
+    cost = report.cost
+    assert cost.predicted_scan_bytes is not None
+    assert cost.admission_tier == "interactive"
+
+    # force the thresholds around the plan's actual prediction
+    monkeypatch.setattr(cost_mod, "ADMISSION_INTERACTIVE_BYTES", 0.0)
+    monkeypatch.setattr(cost_mod, "ADMISSION_HEAVY_BYTES", 1e18)
+    assert cost_mod.cost_tier(cost) == "batch"
+    monkeypatch.setattr(cost_mod, "ADMISSION_HEAVY_BYTES", 1.0)
+    assert cost_mod.cost_tier(cost) == "heavy"
+
+
+# ---------------------------------------------------------------------------
+# controller: soft cancel at partition boundaries
+# ---------------------------------------------------------------------------
+
+
+def test_soft_cancel_only_trips_at_boundary():
+    ctl = RunController()
+    ctl.cancel_at_boundary("preempted")
+    ctl.check(where="mid-batch")  # non-boundary checks sail through
+    with pytest.raises(RunCancelled) as exc:
+        ctl.check(where="partition 2", boundary=True)
+    assert exc.value.code == DQ_PREEMPTED
+    assert exc.value.reason == "preempted"
+
+
+def test_boundary_probe_can_stop_run_with_quota():
+    ctl = RunController()
+    ctl.set_boundary_probe(
+        lambda progress: "quota" if progress.get("partitions_done", 0) >= 2 else None
+    )
+    ctl.check(progress={"partitions_done": 1}, boundary=True)
+    with pytest.raises(RunCancelled) as exc:
+        ctl.check(progress={"partitions_done": 2}, boundary=True)
+    assert exc.value.code == DQ_QUOTA
+
+
+def test_drain_reason_maps_to_dq407():
+    ctl = RunController()
+    ctl.cancel_at_boundary("drain")
+    with pytest.raises(RunCancelled) as exc:
+        ctl.check(boundary=True)
+    assert exc.value.code == DQ_DRAIN
+
+
+# ---------------------------------------------------------------------------
+# preempt → resume bit-identity (the tentpole invariant)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("placement", ["host", "device"])
+def test_preempt_resume_bit_identical_both_placements(
+    placement, monkeypatch, tmp_path
+):
+    """Soft-cancel a partitioned run after its first committed
+    partition, then rerun against the same repository: the resumed run
+    loads the committed states, scans only the remainder, and its
+    result is EXACTLY the uninterrupted run's — snapshot equality,
+    sketches included."""
+    monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+    rng = np.random.default_rng(41_000)
+    checks = [random_check(rng) for _ in range(2)]
+    data_dir = tmp_path / "dataset"
+    data_dir.mkdir()
+    n_parts = 4
+    for i in range(n_parts):
+        _write_partition(random_table(rng), str(data_dir / f"part-{i}.parquet"))
+
+    def build(repo=None):
+        data = Table.scan_parquet_dataset(str(data_dir))
+        builder = VerificationSuite().on_data(data)
+        for check in checks:
+            builder = builder.add_check(check)
+        if repo is not None:
+            builder = builder.with_state_repository(repo, "svc")
+        return builder.with_engine("single")
+
+    baseline = suite_snapshot(build().run())
+
+    repo = FileSystemStateRepository(str(tmp_path / "states"))
+    ctl = RunController()
+    seen = {"parts": 0}
+
+    def preempt_after_first(progress):
+        seen["parts"] = progress.get("partitions_done", 0)
+        return "preempted" if seen["parts"] >= 1 else None
+
+    ctl.set_boundary_probe(preempt_after_first)
+    with pytest.raises(RunCancelled) as exc:
+        build(repo).with_controller(ctl).run()
+    assert exc.value.code == DQ_PREEMPTED
+    done_at_preempt = exc.value.progress.get("partitions_done", seen["parts"])
+    assert 1 <= done_at_preempt < n_parts
+
+    # resume: committed partitions load from the repository
+    resumed = build(repo).with_tracing(True).run()
+    counters = resumed.run_trace.counters
+    assert counters["partitions_cached"] == done_at_preempt
+    assert counters["partitions_scanned"] == n_parts - done_at_preempt
+    assert suite_snapshot(resumed) == baseline
+
+
+# ---------------------------------------------------------------------------
+# the service end to end
+# ---------------------------------------------------------------------------
+
+
+def _wait_all(handles, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    for h in handles:
+        assert h.wait(timeout=max(0.1, deadline - time.monotonic())), h
+    return handles
+
+
+def test_service_runs_suite_to_done():
+    with DQService(workers=2) as svc:
+        h = svc.submit("acme", "orders", _small_table(), checks=[_basic_check()])
+        assert h.wait(timeout=60)
+        assert h.status == "done" and h.code is None
+        assert h.tier == "interactive"
+        assert h.result.status == CheckStatus.SUCCESS
+        snap = svc.telemetry_snapshot()
+        assert snap["engine.service.completed"] == 1.0
+        assert snap["engine.service.admitted"] == 1.0
+
+
+def test_service_rejects_after_close_dq414():
+    svc = DQService(workers=1)
+    svc.close()
+    h = svc.submit("acme", "orders", _small_table(), checks=[_basic_check()])
+    assert h.done() and h.status == "drained" and h.code == DQ_DRAINED
+
+
+def test_service_rejects_never_admittable_dq410():
+    quotas = {"acme": TenantQuota(scan_bytes_per_window=1.0)}
+    with DQService(workers=1, quotas=quotas) as svc:
+        h = svc.submit("acme", "orders", _small_table(), checks=[_basic_check()])
+        assert h.done()  # rejected synchronously, pre-dispatch
+        assert h.status == "rejected" and h.code == DQ_REJECTED
+
+
+def test_service_breaker_trips_on_corrupt_dataset(tmp_path):
+    """Three runs against an unreadable dataset trip the (tenant,
+    dataset) breaker; the fourth is DQ413 without touching a worker,
+    while the tenant's OTHER dataset still runs fine."""
+    bad = tmp_path / "corrupt.parquet"
+    bad.write_bytes(b"PAR1 this is not parquet")
+
+    def bad_data():
+        return Table.scan_parquet_dataset(str(tmp_path))
+
+    with DQService(workers=1, breaker_threshold=3, breaker_cooldown_s=3600) as svc:
+        for _ in range(3):
+            h = svc.submit("acme", "bad", bad_data, checks=[_basic_check()])
+            assert h.wait(timeout=60)
+            assert h.status == "failed"
+        assert svc.breakers.state("acme", "bad") == "open"
+
+        h = svc.submit("acme", "bad", bad_data, checks=[_basic_check()])
+        assert h.done() and h.code == DQ_BREAKER_OPEN
+
+        ok = svc.submit("acme", "good", _small_table(), checks=[_basic_check()])
+        assert ok.wait(timeout=60) and ok.status == "done"
+
+
+def test_service_sheds_on_saturated_queue_dq412(monkeypatch):
+    """With zero idle capacity and a 2-deep interactive queue, the
+    fourth low-priority submission is shed — and a high-priority
+    arrival displaces a queued low-priority one instead."""
+    gate = threading.Event()
+
+    def slow_data():
+        gate.wait(timeout=30)
+        return _small_table()
+
+    svc = DQService(workers=1, queue_limits={"interactive": 2})
+    try:
+        running = svc.submit("t", "d0", slow_data, checks=[_basic_check()])
+        # wait until the worker picked it up so the queue drains to it
+        for _ in range(200):
+            if running.status == "running":
+                break
+            time.sleep(0.01)
+        q1 = svc.submit("t", "d1", _small_table(), checks=[_basic_check()])
+        q2 = svc.submit("t", "d2", _small_table(), checks=[_basic_check()])
+        shed = svc.submit("t", "d3", _small_table(), checks=[_basic_check()])
+        assert shed.done() and shed.status == "shed" and shed.code == DQ_SHED
+
+        vip = svc.submit(
+            "t", "vip", _small_table(), checks=[_basic_check()], priority=5
+        )
+        # the worst queued low-priority item was displaced
+        displaced = [h for h in (q1, q2) if h.done() and h.status == "shed"]
+        assert len(displaced) == 1
+        gate.set()
+        survivors = [h for h in (running, q1, q2, vip) if not h.done()]
+        _wait_all(survivors)
+        assert vip.status == "done"
+        assert svc.telemetry.value("shed") == 2
+    finally:
+        gate.set()
+        svc.close()
+
+
+def test_service_quota_stop_mid_run_dq406(tmp_path):
+    """The tenant's sliding window already holds charges from an
+    earlier run on another dataset; the next run is admissible (DQ319
+    needs predicted > whole window) but overdraws the window at a
+    partition boundary — DQ406 AFTER the boundary's partition
+    committed, so a later run resumes instead of restarting."""
+    rng = np.random.default_rng(7)
+    check = Check(CheckLevel.ERROR, "x").is_complete("x")
+    dirs = []
+    for d in ("d1", "d2"):
+        data_dir = tmp_path / d
+        data_dir.mkdir()
+        for i in range(4):
+            _write_partition(
+                random_table(rng), str(data_dir / f"part-{i}.parquet")
+            )
+        dirs.append(str(data_dir))
+
+    repo = FileSystemStateRepository(str(tmp_path / "states"))
+    p1 = explain_plan(
+        Table.scan_parquet_dataset(dirs[0]), checks=[check]
+    ).cost.predicted_scan_bytes
+    p2 = explain_plan(
+        Table.scan_parquet_dataset(dirs[1]), checks=[check]
+    ).cost.predicted_scan_bytes
+    assert p1 and p2
+    # fits either run alone (no DQ319) but not both inside one window
+    window = max(p1, p2) * 1.25
+    quotas = {"tight": TenantQuota(scan_bytes_per_window=window, window_s=3600)}
+
+    with DQService(workers=1, quotas=quotas, state_repository=repo) as svc:
+        first = svc.submit(
+            "tight", "d1",
+            lambda: Table.scan_parquet_dataset(dirs[0]), checks=[check],
+        )
+        assert first.wait(timeout=120) and first.status == "done", first.reason
+        h = svc.submit(
+            "tight", "d2",
+            lambda: Table.scan_parquet_dataset(dirs[1]), checks=[check],
+        )
+        assert h.wait(timeout=120)
+        assert h.status == "quota"
+        assert h.code == DQ_QUOTA
+        assert svc.telemetry.value("quota_stops") == 1
+    # the stopped run committed the partitions it finished
+    committed = list((tmp_path / "states").rglob("*.dqstate"))
+    assert committed
+
+
+def test_service_preempts_heavy_for_interactive_then_resumes(
+    monkeypatch, tmp_path
+):
+    """End-to-end preemptive scheduling on one worker: a running heavy
+    profile is soft-cancelled when interactive work arrives, the
+    interactive check runs first, and the heavy run resumes from its
+    committed partition states to a result bit-identical to a solo
+    run."""
+    from deequ_tpu.lint import cost as cost_mod
+
+    rng = np.random.default_rng(31_337)
+    check = Check(CheckLevel.ERROR, "x").is_complete("x")
+    data_dir = tmp_path / "heavy"
+    data_dir.mkdir()
+    n_parts = 4
+    for i in range(n_parts):
+        _write_partition(random_table(rng), str(data_dir / f"part-{i}.parquet"))
+
+    def heavy_data():
+        return Table.scan_parquet_dataset(str(data_dir))
+
+    solo = suite_snapshot(
+        VerificationSuite()
+        .on_data(heavy_data())
+        .add_check(check)
+        .with_engine("single")
+        .run()
+    )
+
+    # slow each row-group read so the preemption window is wide
+    monkeypatch.setenv("DEEQU_TPU_SOURCE_STALL_MS", "150")
+    repo = FileSystemStateRepository(str(tmp_path / "states"))
+    with DQService(workers=1, state_repository=repo) as svc:
+        # classify the big submission as heavy regardless of its size
+        monkeypatch.setattr(cost_mod, "ADMISSION_INTERACTIVE_BYTES", 0.0)
+        monkeypatch.setattr(cost_mod, "ADMISSION_HEAVY_BYTES", 1.0)
+        heavy = svc.submit("works", "big", heavy_data, checks=[check])
+        assert heavy.tier == "heavy"
+        monkeypatch.setattr(cost_mod, "ADMISSION_INTERACTIVE_BYTES", 64 << 20)
+        monkeypatch.setattr(cost_mod, "ADMISSION_HEAVY_BYTES", 1 << 30)
+
+        for _ in range(500):
+            if heavy.status == "running":
+                break
+            time.sleep(0.01)
+        assert heavy.status == "running"
+
+        monkeypatch.delenv("DEEQU_TPU_SOURCE_STALL_MS")
+        inter = svc.submit("ops", "ping", _small_table(), checks=[_basic_check()])
+        assert inter.tier == "interactive"
+        assert inter.wait(timeout=120) and inter.status == "done"
+        assert heavy.wait(timeout=180)
+        assert heavy.status == "done", (heavy.status, heavy.reason)
+        if heavy.preemptions:
+            # the interactive check finished BEFORE the preempted heavy
+            # run was resumed, and resumption was a real resume: some
+            # partitions loaded from the repository
+            assert svc.telemetry.value("preempted") >= 1
+        assert suite_snapshot(heavy.result) == solo
+    committed = list((tmp_path / "states").rglob("*.dqstate"))
+    assert committed
+
+
+def test_service_telemetry_persists_via_engine_repository():
+    metrics = InMemoryMetricsRepository()
+    with DQService(workers=1, metrics_repository=metrics) as svc:
+        h = svc.submit("acme", "orders", _small_table(), checks=[_basic_check()])
+        assert h.wait(timeout=60) and h.status == "done"
+        svc.publish_telemetry()
+    series = engine_series(
+        metrics, "engine.service.completed", instance="service"
+    )
+    assert series and series[-1].metric_value >= 1.0
+
+
+def test_service_multi_tenant_fuzz_isolation(tmp_path):
+    """N tenants submit concurrently; every run's result is
+    bit-identical to the same suite run solo — no cross-tenant state
+    bleed through the shared pool, repository, or ledger."""
+    rng = np.random.default_rng(9_900)
+    tenants = []
+    for t in range(4):
+        table = random_table(rng)
+        checks = [random_check(rng)]
+        builder = VerificationSuite().on_data(table)
+        for c in checks:
+            builder = builder.add_check(c)
+        solo = suite_snapshot(builder.with_engine("single").run())
+        tenants.append((f"tenant-{t}", table, checks, solo))
+
+    repo = FileSystemStateRepository(str(tmp_path / "states"))
+    with DQService(workers=3, state_repository=repo) as svc:
+        handles = []
+        for name, table, checks, _ in tenants * 2:  # two rounds each
+            handles.append(svc.submit(name, "ds", table, checks=checks))
+        _wait_all(handles, timeout=180)
+        for h, (name, _, _, solo) in zip(handles, tenants * 2):
+            assert h.status == "done", (name, h.status, h.reason)
+            assert suite_snapshot(h.result) == solo, name

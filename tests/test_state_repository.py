@@ -410,6 +410,31 @@ class TestKeys:
         _random_table(rng, 301).to_parquet(path, row_group_size=100)
         assert partition_fingerprint(path) != fp1
 
+    def test_fingerprint_memoized_by_stat_signature(self, tmp_path, monkeypatch):
+        """An unchanged file (same device/inode/size/mtime_ns) must hit
+        the fingerprint memo without re-reading the parquet footer —
+        that's what keeps a preempted run's time-to-first-resume-boundary
+        flat in partition count. Any rewrite changes the stat signature
+        and recomputes."""
+        import pyarrow.parquet as pq
+
+        from deequ_tpu.data.source import partition_fingerprint
+
+        rng = np.random.default_rng(5)
+        path = str(tmp_path / "m0.parquet")
+        _random_table(rng, 200).to_parquet(path, row_group_size=100)
+        fp1 = partition_fingerprint(path)
+
+        def boom(*args, **kwargs):
+            raise AssertionError("footer re-read on unchanged file")
+
+        monkeypatch.setattr(pq, "ParquetFile", boom)
+        assert partition_fingerprint(path) == fp1
+        monkeypatch.undo()
+
+        _random_table(rng, 201).to_parquet(path, row_group_size=100)
+        assert partition_fingerprint(path) != fp1
+
     def test_plan_signature_sensitivity(self):
         base = dict(
             placement="device", compute_dtype="float64",

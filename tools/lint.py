@@ -73,9 +73,11 @@ Checks:
              snapshots are operational metadata that leaves the trust
              boundary (dashboards, scrapes, log shippers). Row evidence
              belongs to the audit trail an operator explicitly loads.
-  FAULTS   — fault containment in the stage-worker and readahead files
-             (deequ_tpu/ops/pipeline.py, deequ_tpu/data/source.py,
-             deequ_tpu/data/native_reader.py): no bare `except:` and no
+  FAULTS   — fault containment in the stage-worker, readahead, and
+             DQ-service files (deequ_tpu/ops/pipeline.py,
+             deequ_tpu/data/source.py, deequ_tpu/data/native_reader.py,
+             deequ_tpu/service/service.py, deequ_tpu/service/admission.py,
+             deequ_tpu/service/breaker.py): no bare `except:` and no
              silently-swallowed exceptions (a handler whose body is
              only `pass`) — every contained fault must count itself
              (runtime.record_fault / record_retry) or land in a degrade
@@ -155,12 +157,18 @@ SERDE_FILES = [
     os.path.join("deequ_tpu", "repository", "audit.py"),
     os.path.join("deequ_tpu", "analyzers", "state_provider.py"),
 ]
-# Stage-worker and readahead files where swallowed exceptions are
-# banned: a fault contained here must be counted or degrade loudly.
+# Stage-worker, readahead, and DQ-service files where swallowed
+# exceptions are banned: a fault contained here must be counted or
+# degrade loudly. The service files carry multi-tenant blast radius —
+# a silently-eaten worker fault would fail other tenants' runs with no
+# forensics at all.
 FAULTS_FILES = [
     os.path.join("deequ_tpu", "ops", "pipeline.py"),
     os.path.join("deequ_tpu", "data", "source.py"),
     os.path.join("deequ_tpu", "data", "native_reader.py"),
+    os.path.join("deequ_tpu", "service", "service.py"),
+    os.path.join("deequ_tpu", "service", "admission.py"),
+    os.path.join("deequ_tpu", "service", "breaker.py"),
 ]
 # The chaos harness's registry: every fault_point("<name>") literal in
 # deequ_tpu/ must be a key of FAULT_KINDS in this module.

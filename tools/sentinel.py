@@ -83,6 +83,14 @@ WATCHED_SERIES: Sequence[Tuple[str, str]] = (
     # rise means faults are escaping the retry layer and landing on the
     # slow path
     ("engine.fault.fallback_ratio", "up"),
+    # DQ service overload shedding: the fraction of submissions shed at
+    # admission (DQ412); growth means the pool is saturated — queues
+    # too small, workers too few, or a tenant flooding past its quota
+    ("engine.service.shed_ratio", "up"),
+    # DQ service circuit breakers currently open: a rise means more
+    # (tenant, dataset) pairs are repeatedly failing their runs and
+    # being fenced off from the pool (corrupt upstream tables)
+    ("engine.service.breaker_open", "up"),
 )
 
 #: phases whose share of wall time is watched (rises are bad: a phase
