@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint typecheck analyze sentinel test test-fast trace-demo chaos service-chaos bench-pushdown bench-decode bench-wire bench-incremental bench-reader bench-forensics bench-chaos bench-service clean-native
+.PHONY: lint typecheck analyze sentinel test test-fast trace-demo chaos service-chaos bench-pushdown bench-decode bench-wire bench-incremental bench-reader bench-forensics bench-chaos bench-service bench-mesh clean-native
 
 lint:
 	$(PY) tools/lint.py
@@ -122,6 +122,16 @@ bench-service:
 BENCH_CHAOS_ROWS ?= 2000000
 bench-chaos:
 	JAX_PLATFORMS=cpu BENCH_MODE=chaos BENCH_ROWS=$(BENCH_CHAOS_ROWS) $(PY) bench.py
+
+# sharded streaming scan scaling curve (ISSUE 15): the IO-latency-bound
+# cold pass at 1/2/4 REAL processes, rendezvous partition sharding,
+# states-only allgather. Must reach >=3x wall at 4 processes with
+# per-process scan throughput within 15% of solo, and every mesh size
+# must report metrics bit-identical to the solo pass. Refreshes
+# BENCH_MESH.json (methodology: BENCH.md round 15)
+BENCH_MESH_ROWS ?= 128000
+bench-mesh:
+	JAX_PLATFORMS=cpu BENCH_MESH_ROWS=$(BENCH_MESH_ROWS) $(PY) tools/bench_mesh.py
 
 # remove cached native builds (the hash-named .so files): any strays in
 # the package tree from older versions plus the per-user cache dir the

@@ -508,6 +508,17 @@ class _NumericScanAnalyzer(ScanShareableAnalyzer):
         )
 
 
+def _pallas_moments(x, m):
+    """(count, sum, min, max) via the single-HBM-pass pallas fold when
+    the knob/platform/shape allow, else None — the caller then runs its
+    XLA fold. Blocked summation is a different float order, so whenever
+    this fires the plan signature carries the "pallas-folds" variant
+    (runtime.fold_variant()) and cached states never cross arithmetics."""
+    from deequ_tpu.ops import pallas_kernels
+
+    return pallas_kernels.fold_moments_or_none(x, m)
+
+
 @dataclass(frozen=True)
 class Mean(_NumericScanAnalyzer):
     """reference: analyzers/Mean.scala:36."""
@@ -524,6 +535,10 @@ class Mean(_NumericScanAnalyzer):
             mom = self._moments(inputs)
             return {"total": mom["sum"], "count": mom["count"]}
         x, m = self._masked(inputs, xp)
+        folded = _pallas_moments(x, m)
+        if folded is not None:
+            count, total, _mn, _mx = folded
+            return {"total": total, "count": count}
         return {"total": xp.sum(x * m), "count": xp.sum(m)}
 
     def merge_agg(self, a: Any, b: Any, xp) -> Any:
@@ -560,6 +575,10 @@ class Sum(_NumericScanAnalyzer):
             mom = self._moments(inputs)
             return {"sum": mom["sum"], "count": mom["count"]}
         x, m = self._masked(inputs, xp)
+        folded = _pallas_moments(x, m)
+        if folded is not None:
+            count, total, _mn, _mx = folded
+            return {"sum": total, "count": count}
         return {"sum": xp.sum(x * m), "count": xp.sum(m)}
 
     def merge_agg(self, a: Any, b: Any, xp) -> Any:
@@ -596,6 +615,10 @@ class Minimum(_NumericScanAnalyzer):
             mom = self._moments(inputs)
             return {"min": mom["min"], "count": mom["count"]}
         x, m = self._masked(inputs, xp)
+        folded = _pallas_moments(x, m)
+        if folded is not None:
+            count, _total, mn, _mx = folded
+            return {"min": mn, "count": count}
         masked = xp.where(m > 0, x, xp.inf)
         return {"min": xp.min(masked), "count": xp.sum(m)}
 
@@ -633,6 +656,10 @@ class Maximum(_NumericScanAnalyzer):
             mom = self._moments(inputs)
             return {"max": mom["max"], "count": mom["count"]}
         x, m = self._masked(inputs, xp)
+        folded = _pallas_moments(x, m)
+        if folded is not None:
+            count, _total, _mn, mx = folded
+            return {"max": mx, "count": count}
         masked = xp.where(m > 0, x, -xp.inf)
         return {"max": xp.max(masked), "count": xp.sum(m)}
 
@@ -679,6 +706,15 @@ class StandardDeviation(_NumericScanAnalyzer):
                 "m2": mom["m2"],
             }
         x, m = self._masked(inputs, xp)
+        folded = _pallas_moments(x, m)
+        if folded is not None:
+            from deequ_tpu.ops import pallas_kernels
+
+            n, total, _mn, _mx = folded
+            safe_n = xp.maximum(n, 1.0)
+            avg = total / safe_n
+            m2 = pallas_kernels.masked_centered_sumsq(x, m, avg)
+            return {"n": n, "avg": xp.where(n > 0, avg, 0.0), "m2": m2}
         n = xp.sum(m)
         safe_n = xp.maximum(n, 1.0)
         avg = xp.sum(x * m) / safe_n

@@ -53,6 +53,7 @@ check with the run's progress dict and may return a soft-cancel reason
 
 from __future__ import annotations
 
+import os
 import random
 import sys
 import threading
@@ -159,6 +160,23 @@ class RunController:
         service charges per-partition quota usage through it."""
         self._boundary_probe = probe
 
+    def bind_shared_cancel(self, token: "SharedCancelToken") -> None:
+        """Chain a cross-process `SharedCancelToken` into the boundary
+        probe: a token tripped by ANY shard (or the launcher) cancels
+        this run at its next partition boundary — the partition in
+        flight commits first, exactly like a service preemption. An
+        existing probe keeps running and wins on a non-None reason."""
+        prev = self._boundary_probe
+
+        def probe(progress: Dict[str, Any]) -> Optional[str]:
+            if prev is not None:
+                reason = prev(progress)
+                if reason:
+                    return reason
+            return token.reason()
+
+        self._boundary_probe = probe
+
     @property
     def cancelled(self) -> bool:
         return self._cancel.is_set()
@@ -205,6 +223,53 @@ class RunController:
                 raise RunCancelled(
                     self._soft_reason, where=where, progress=progress
                 )
+
+
+class SharedCancelToken:
+    """Cross-process boundary-cancel rendezvous for the sharded scan
+    (parallel/multihost.py): one file on a filesystem every shard can
+    see. Tripping publishes a reason atomically (tmp + rename); every
+    shard's boundary probe (`RunController.bind_shared_cancel`) polls
+    `reason()` at its partition boundaries — a stat of one path, no
+    collective, so a cancel propagates without waiting for the next
+    allgather. First trip effectively wins (a near-simultaneous second
+    trip may overwrite the reason; ANY published reason cancels).
+
+    All failure modes degrade to "not tripped": a token on a vanished
+    directory simply never fires, it cannot wedge or crash a run."""
+
+    def __init__(self, path: str) -> None:
+        self.path = str(path)
+
+    def trip(self, reason: str = "cancelled") -> None:
+        if os.path.exists(self.path):
+            return
+        tmp = f"{self.path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as handle:
+                handle.write(reason)
+            os.replace(tmp, self.path)
+        except OSError:  # fault-ok: a failed trip = not tripped
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def reason(self) -> Optional[str]:
+        """The published cancel reason, or None while untripped. An
+        empty or unreadable file reads as a plain "cancelled"."""
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with open(self.path, encoding="utf-8") as handle:
+                text = handle.read().strip()
+        except OSError:
+            return "cancelled"
+        return text or "cancelled"
+
+    @property
+    def tripped(self) -> bool:
+        return self.reason() is not None
 
 
 class StallWatchdog:
@@ -353,6 +418,7 @@ __all__ = [
     "SOFT_REASONS",
     "RunCancelled",
     "RunController",
+    "SharedCancelToken",
     "StallWatchdog",
     "backoff_s",
     "retry_call",

@@ -1560,6 +1560,26 @@ class PartitionedParquetSource(DataSource):
             self.paths, columns=keep, batch_rows=self.batch_rows
         )
 
+    def subset(self, paths) -> "PartitionedParquetSource":
+        """Shard-filtered view of the dataset: the same source restricted
+        to `paths` (a shard's slice from `parallel/shard.py`), preserving
+        column projection, batch sizing and — critically — the global
+        name order, so a per-shard fold merges its partitions in exactly
+        the order the solo fold visits them. Unknown paths are a plan
+        bug, not data: raise instead of silently scanning less."""
+        keep = set(str(p) for p in paths)
+        unknown = keep - set(self.paths)
+        if unknown:
+            raise ValueError(
+                f"subset paths not in this dataset: {sorted(unknown)}"
+            )
+        picked = [p for p in self.paths if p in keep]
+        if not picked:
+            raise ValueError("subset would leave no partitions")
+        return PartitionedParquetSource(
+            picked, columns=self.columns, batch_rows=self.batch_rows
+        )
+
     def decode_column_types(self):
         """Decode vocabulary of the dataset (all partitions share one
         schema): delegate to the first partition."""

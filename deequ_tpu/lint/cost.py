@@ -212,6 +212,13 @@ class PlanCost:
     span_counts: Dict[str, int] = field(default_factory=dict)
     num_hosts: int = 1
     allgather_rounds: int = 0
+    #: sharded streaming scan (parallel/multihost.run_sharded_analysis):
+    #: processes in the mesh and each one's partition-slice size in
+    #: shard order (from parallel/shard.plan_shards) — rendered in
+    #: EXPLAIN's `shards:` line and pinned against the observed
+    #: `shard.count` / `shard.partitions_max` trace counters
+    num_shards: int = 1
+    shard_partitions: Tuple[int, ...] = ()
     #: stream-pipeline prediction for the scan pass; None for
     #: non-streaming plans (in-memory tables never engage the pipeline)
     pipeline: Optional[PipelineCost] = None
@@ -237,6 +244,19 @@ class PlanCost:
     #: `quota_scan_bytes`; negative means the plan overdraws the window
     #: and DQ319 fires when it can NEVER fit
     quota_headroom_bytes: Optional[float] = None
+
+    @property
+    def shard_partitions_max(self) -> int:
+        """The largest shard's partition count (the straggler bound)."""
+        return max(self.shard_partitions) if self.shard_partitions else 0
+
+    @property
+    def shard_skew(self) -> float:
+        """Largest shard over the even split; 1.0 = perfectly balanced."""
+        total = sum(self.shard_partitions)
+        if not total or self.num_shards < 1:
+            return 1.0
+        return self.shard_partitions_max / (total / self.num_shards)
 
     @property
     def total_read_bytes_per_row(self) -> float:
@@ -425,6 +445,18 @@ def cost_drift(cost: "PlanCost", trace: Any) -> Dict[str, float]:
                 int(trace.counters.get("partitions_scanned", 0))
                 - (scan.partitions_total - scan.partitions_cached)
             )
+
+    # sharded-scan pins: the shard planner is deterministic, so the
+    # observed shard split must equal the predicted one exactly
+    if cost.num_shards > 1 and "shard.count" in trace.counters:
+        out["drift.shard_count"] = float(
+            int(trace.counters.get("shard.count", 0)) - cost.num_shards
+        )
+        if cost.shard_partitions:
+            out["drift.shard_partitions_max"] = float(
+                int(trace.counters.get("shard.partitions_max", 0))
+                - cost.shard_partitions_max
+            )
     return out
 
 
@@ -504,6 +536,8 @@ def analyze_plan(
     placement: Optional[str] = None,
     engine: str = "single",
     num_hosts: int = 1,
+    num_shards: int = 1,
+    shard_partitions: Optional[Sequence[int]] = None,
     num_devices: int = 1,
     streaming: bool = False,
     stream_batch_rows: Optional[int] = None,
@@ -541,6 +575,11 @@ def analyze_plan(
     reasons, and the intermediate materialization bytes avoided — via
     the SAME classifier the runtime planner runs, so
     `drift.decode_cols_fast` pins to zero.
+
+    `num_shards` / `shard_partitions` (per-shard partition counts in
+    shard order, from `parallel/shard.plan_shards`) describe a sharded
+    streaming scan: rendered in EXPLAIN's `shards:` line and pinned
+    against the observed `shard.*` trace counters.
 
     `partitions` (per-partition `{"cached": bool, "bytes": int}` records
     from the runner's state-repository probe, partition order) switches
@@ -602,6 +641,8 @@ def analyze_plan(
         analyzers=tuple(repr(a) for a in unique),
         precondition_failures=tuple(failures),
         num_hosts=max(1, int(num_hosts)),
+        num_shards=max(1, int(num_shards)),
+        shard_partitions=tuple(int(c) for c in (shard_partitions or ())),
         counters={k: 0 for k in COUNTERS},
         span_counts={k: 0 for k in EXECUTION_SPANS},
         retry_budget=runtime.retry_budget(),

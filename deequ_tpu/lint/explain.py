@@ -463,6 +463,13 @@ def render_explain(
         head.append(
             f"hosts: {cost.num_hosts}   allgather rounds: {cost.allgather_rounds}"
         )
+    if cost.num_shards > 1:
+        total = sum(cost.shard_partitions)
+        per = -(-total // cost.num_shards) if total else 0  # ceil
+        head.append(
+            f"shards: {cost.num_shards} processes × {per} partitions each "
+            f"(max skew {cost.shard_skew:.2f})"
+        )
     if cost.precondition_failures:
         head.append(
             f"precondition failures: {len(cost.precondition_failures)} "
@@ -599,6 +606,8 @@ def explain_plan(
     placement: Optional[str] = None,
     engine: str = "single",
     num_hosts: int = 1,
+    num_shards: int = 1,
+    shard_partitions: Optional[Sequence[int]] = None,
     num_devices: int = 1,
     streaming: Optional[bool] = None,
     stream_batch_rows: Optional[int] = None,
@@ -628,6 +637,10 @@ def explain_plan(
     `decode_types` likewise defaults to the source's own decode
     vocabulary (`decode_column_types()`), which turns on the decode
     fast-path prediction and the per-column DQ312 fallback lints.
+
+    `num_shards` / `shard_partitions` (per-shard partition counts from
+    `parallel.shard.plan_shards`) describe a sharded streaming scan and
+    add the `shards: N processes × K partitions each (max skew S)` line.
 
     `quota_scan_bytes` — a tenant's scan-bytes-per-window budget (the
     DQService admission path supplies it) — adds the quota headroom to
@@ -666,6 +679,8 @@ def explain_plan(
         placement=placement,
         engine=engine,
         num_hosts=num_hosts,
+        num_shards=num_shards,
+        shard_partitions=shard_partitions,
         num_devices=num_devices,
         streaming=bool(streaming),
         stream_batch_rows=stream_batch_rows,

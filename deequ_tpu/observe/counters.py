@@ -158,6 +158,33 @@ def record_fault(injected: int = 0, fallback_units: int = 0) -> None:
             tracer.count("fault.fallback_units", int(fallback_units))
 
 
+def record_shard_scan(
+    shard: int,
+    num_shards: int,
+    partitions_local: int,
+    partitions_max: int,
+    partitions_total: int,
+    merge_bytes: int,
+    rows_local: int,
+) -> None:
+    """Shard-split outcome of one sharded streaming scan (one record per
+    participating process): which shard this is out of how many, its
+    partition slice vs the largest shard's and the dataset total, the
+    gathered state-envelope bytes that crossed the process boundary,
+    and the rows this shard folded. Tracer-only, like
+    record_state_cache; the counters feed cost_drift's shard pins and
+    the `engine.shard.*` telemetry series the sentinel watches."""
+    tracer = spans.current_tracer()
+    if tracer is not None:
+        tracer.count("shard.index", int(shard))
+        tracer.count("shard.count", int(num_shards))
+        tracer.count("shard.partitions_local", int(partitions_local))
+        tracer.count("shard.partitions_max", int(partitions_max))
+        tracer.count("shard.partitions_total", int(partitions_total))
+        tracer.count("shard.merge_bytes", int(merge_bytes))
+        tracer.count("shard.rows_local", int(rows_local))
+
+
 def record_state_cache(cached: int, scanned: int, total: int) -> None:
     """Partition-split outcome of one partitioned fused scan: partitions
     whose states loaded from the state cache vs partitions that decoded

@@ -249,6 +249,28 @@ def engine_metric_record(
             rec.get("engine.counter.fault.fallback_units", 0.0) / faults
         )
 
+    # derived: sharded-scan health (one record per participating
+    # process). skew_ratio = this mesh's largest shard vs the even
+    # split (1.0 = perfectly balanced; the sentinel watches it rising),
+    # rows_per_s = THIS shard's fold throughput (watched dropping),
+    # merge_bytes = gathered state-envelope bytes that crossed the
+    # process boundary (watched rising — states, never rows, so this
+    # should stay KB-scale). Only present when a sharded scan ran.
+    shard_count = rec.get("engine.counter.shard.count", 0.0)
+    if shard_count > 0.0:
+        shard_total = rec.get("engine.counter.shard.partitions_total", 0.0)
+        if shard_total > 0.0:
+            rec["engine.shard.skew_ratio"] = rec.get(
+                "engine.counter.shard.partitions_max", 0.0
+            ) / (shard_total / shard_count)
+        rec["engine.shard.merge_bytes"] = rec.get(
+            "engine.counter.shard.merge_bytes", 0.0
+        )
+        if wall > 0.0:
+            rec["engine.shard.rows_per_s"] = (
+                rec.get("engine.counter.shard.rows_local", 0.0) / wall
+            )
+
     # satellite: traced_run stamps these on the root span; live /proc read
     # covers traces produced before the attributes existed.
     res = proc_resources()

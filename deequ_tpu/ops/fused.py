@@ -554,6 +554,27 @@ def _merge_partition_results(
         return AnalyzerRunResult(a.analyzer, error=e)
 
 
+def scan_partition(
+    analyzers,
+    partition,
+    *,
+    batch_size=None,
+    forensics=None,
+    controller=None,
+):
+    """Fold ONE partition to per-analyzer results through the normal
+    single-source fused path (native reader read-ahead, decode->wire
+    fusion, backpressured pipeline — everything a whole-dataset scan
+    uses). This is the one sub-scan both `_run_partitioned` and the
+    sharded scan (parallel/multihost.py) call, which is what makes a
+    shard's per-partition states byte-identical to a solo run's: same
+    analyzer list, same batch sizing, same fold — same bits."""
+    sub = FusedScanPass(
+        analyzers, batch_size, forensics=forensics, controller=controller
+    )
+    return sub.run(partition.source())
+
+
 def _to_f64(tree: Any) -> Any:
     return jax.tree_util.tree_map(
         lambda x: np.asarray(x, dtype=np.float64), tree
@@ -1954,6 +1975,7 @@ class FusedScanPass:
                     self.batch_size if self._batch_size_explicit else None
                 ),
                 batch_rows=int(batch_rows) if batch_rows else None,
+                variant=runtime.fold_variant(),
             )
         if cap is not None:
             cap.note_plan_signature(signature)
@@ -1996,9 +2018,12 @@ class FusedScanPass:
                     if cap is not None:
                         cap.note_partition(part.name, part.fingerprint, "cache")
             if results is None:
-                sub = FusedScanPass(
+                results = scan_partition(
                     self.analyzers,
-                    self.batch_size if self._batch_size_explicit else None,
+                    part,
+                    batch_size=(
+                        self.batch_size if self._batch_size_explicit else None
+                    ),
                     forensics=(
                         cap.enter_partition(part.name, part.fingerprint)
                         if cap is not None
@@ -2006,7 +2031,6 @@ class FusedScanPass:
                     ),
                     controller=ctl,
                 )
-                results = sub.run(part.source())
                 scanned_n += 1
                 if cap is not None:
                     cap.note_partition(part.name, part.fingerprint, "scan")

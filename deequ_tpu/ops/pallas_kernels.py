@@ -187,6 +187,123 @@ def hist16(bins, interpret: bool = False):
     )(bins2d)
 
 
+# ---------------------------------------------------------------------------
+# masked moment folds: count/sum/min/max (+ centered sum-of-squares)
+# ---------------------------------------------------------------------------
+#
+# The numeric analyzers' per-batch folds (Mean/Sum/Minimum/Maximum/
+# StandardDeviation) are masked reductions XLA handles as separate
+# reduce ops, each re-reading the (x, m) operands from HBM. The pallas
+# form reads every (8, 128) block ONCE and accumulates all four partials
+# in VMEM over the sequential grid — one HBM pass for the whole moment
+# set — with a tiny XLA lane-reduce epilog outside the kernel.
+#
+# BIT-IDENTITY CAVEAT: blocked accumulation is a different float
+# summation ORDER than XLA's flat reduce, so sums/means need not match
+# an XLA fold bitwise (min/max/count are exact in any order). That is
+# why `runtime.fold_variant()` hashes "pallas-folds" into the plan
+# signature: committed states from the two arithmetics never mix in the
+# state cache. tests/test_pallas_kernels.py pins the kernels bitwise
+# against an identically-blocked XLA reference (and exactly against the
+# naive fold for the order-insensitive stats).
+
+
+def _masked_moments_kernel(x_ref, m_ref, cnt_ref, sum_ref, min_ref, max_ref):
+    from jax.experimental import pallas as pl
+
+    x = x_ref[:]  # (BLOCK_ROWS, 128) f32
+    m = m_ref[:]  # (BLOCK_ROWS, 128) f32 in {0, 1}
+    live = m > 0
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        cnt_ref[:] = jnp.zeros((_BLOCK_ROWS, 128), dtype=jnp.float32)
+        sum_ref[:] = jnp.zeros((_BLOCK_ROWS, 128), dtype=jnp.float32)
+        min_ref[:] = jnp.full((_BLOCK_ROWS, 128), jnp.inf, dtype=jnp.float32)
+        max_ref[:] = jnp.full((_BLOCK_ROWS, 128), -jnp.inf, dtype=jnp.float32)
+
+    cnt_ref[:] = cnt_ref[:] + m
+    sum_ref[:] = sum_ref[:] + x * m
+    min_ref[:] = jnp.minimum(min_ref[:], jnp.where(live, x, jnp.inf))
+    max_ref[:] = jnp.maximum(max_ref[:], jnp.where(live, x, -jnp.inf))
+
+
+def masked_moments(x, m, interpret: bool = False):
+    """(count, sum, min, max) scalars of `x` under mask `m` in one pass.
+
+    `x` length must be a multiple of 1024 (`shape_supported`); masked
+    rows (m == 0) contribute nothing: 0 to count/sum, ±inf identities to
+    min/max — exactly the analyzers' XLA fold semantics."""
+    from jax.experimental import pallas as pl
+
+    n = x.shape[0]
+    grid = n // _BLOCK
+    x2d = x.reshape(grid * _BLOCK_ROWS, 128).astype(jnp.float32)
+    m2d = m.reshape(grid * _BLOCK_ROWS, 128).astype(jnp.float32)
+    tile = pl.BlockSpec((_BLOCK_ROWS, 128), lambda i: (i, 0))
+    acc = pl.BlockSpec((_BLOCK_ROWS, 128), lambda i: (0, 0))
+    out = jax.ShapeDtypeStruct((_BLOCK_ROWS, 128), jnp.float32)
+    cnt, total, mn, mx = pl.pallas_call(
+        _masked_moments_kernel,
+        grid=(grid,),
+        in_specs=[tile, tile],
+        out_specs=[acc, acc, acc, acc],
+        out_shape=[out, out, out, out],
+        interpret=interpret,
+    )(x2d, m2d)
+    return jnp.sum(cnt), jnp.sum(total), jnp.min(mn), jnp.max(mx)
+
+
+def _sumsq_kernel(d_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    d = d_ref[:]
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros((_BLOCK_ROWS, 128), dtype=jnp.float32)
+
+    out_ref[:] = out_ref[:] + d * d
+
+
+def masked_centered_sumsq(x, m, avg, interpret: bool = False):
+    """sum(((x - avg) * m)^2) — StandardDeviation's m2 fold. The
+    centering is a cheap XLA prolog; the square-accumulate runs blocked
+    in VMEM like `masked_moments`. Same shape contract."""
+    from jax.experimental import pallas as pl
+
+    n = x.shape[0]
+    grid = n // _BLOCK
+    d = ((x.astype(jnp.float32) - avg) * m.astype(jnp.float32)).reshape(
+        grid * _BLOCK_ROWS, 128
+    )
+    out = pl.pallas_call(
+        _sumsq_kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((_BLOCK_ROWS, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((_BLOCK_ROWS, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((_BLOCK_ROWS, 128), jnp.float32),
+        interpret=interpret,
+    )(d)
+    return jnp.sum(out)
+
+
+def fold_moments_or_none(x, m):
+    """The analyzers' gate: (count, sum, min, max) via the pallas fold
+    when the knob, platform, and shape all allow — else None and the
+    caller runs its XLA fold. Mirrors `runtime.fold_variant()`: whenever
+    this returns non-None, the plan signature carries "pallas-folds"."""
+    from deequ_tpu.ops import runtime
+
+    if not runtime.pallas_folds_enabled():
+        return None
+    if getattr(x, "ndim", 0) != 1 or not shape_supported(int(x.shape[0])):
+        return None
+    if not usable():
+        return None
+    return masked_moments(x, m)
+
+
 def f32_sortable_bin16(values_f32, live_mask):
     """Top-16 sortable-key bins for float32 values (order-preserving:
     bin ascending == value ascending); excluded rows get sentinel 65535.

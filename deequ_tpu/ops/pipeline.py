@@ -178,8 +178,13 @@ def staged(
                         error.append(e)
             _put(_SENTINEL)
 
+    # stack dumps / py-spy on a mesh worker must say WHICH shard's
+    # pipeline a stage thread belongs to
+    tag = runtime.shard_tag()
     thread = threading.Thread(
-        target=worker, daemon=True, name=f"deequ-pipe-{name}"
+        target=worker,
+        daemon=True,
+        name=f"deequ-pipe-{name}" + (f"-shard{tag}" if tag else ""),
     )
     thread.start()
     try:

@@ -264,6 +264,46 @@ def state_cache_enabled() -> bool:
     return os.environ.get("DEEQU_TPU_STATE_CACHE", "") not in ("0", "off")
 
 
+def pallas_folds_enabled() -> bool:
+    """Whether the numeric moments/min-max state folds may run as
+    Pallas kernels (ops/pallas_kernels.py) on platforms that compile
+    them. `DEEQU_TPU_PALLAS_FOLDS=0` (or `off`) is the kill switch.
+    Call sites additionally require `pallas_kernels.usable()` (a TPU
+    probe — always False on CPU, where the XLA fold runs unchanged) and
+    a block-aligned batch shape. UNLIKE the pipeline/pushdown/decode
+    knobs, the blocked Pallas sum is NOT bit-identical to the XLA
+    reduction, so this knob enters the plan signature as a fold
+    variant (`fold_variant`) — cached states never cross the two
+    arithmetics."""
+    import os
+
+    return os.environ.get("DEEQU_TPU_PALLAS_FOLDS", "") not in ("0", "off")
+
+
+def fold_variant() -> str:
+    """The fold-arithmetic variant tag the plan signature hashes:
+    "pallas-folds" when the Pallas moments folds are enabled AND the
+    platform actually compiles them, else "" (the default arithmetic —
+    signatures unchanged). On CPU this is always "" — interpret-mode
+    kernel runs live only in tests, never in the product fold."""
+    if not pallas_folds_enabled():
+        return ""
+    from deequ_tpu.ops import pallas_kernels
+
+    return "pallas-folds" if pallas_kernels.usable() else ""
+
+
+def shard_tag() -> str:
+    """This process's shard tag in a sharded scan (`DEEQU_TPU_SHARD`,
+    set by the mesh launcher for each worker): a short string like "2"
+    that worker-thread names and heartbeat lines carry, so watchdog
+    dumps and merged cross-process traces attribute work to the right
+    shard. Empty outside sharded runs — names are unchanged."""
+    import os
+
+    return os.environ.get("DEEQU_TPU_SHARD", "")
+
+
 def native_reader_enabled() -> bool:
     """Whether planner-approved column chunks may be read by the native
     parquet reader (ops/native/parquet_read.c): page headers parsed,
@@ -727,6 +767,26 @@ def record_retry(attempts: int, recovered: int, exhausted: int) -> None:
 
 def record_fault(injected: int = 0, fallback_units: int = 0) -> None:
     _counters.record_fault(injected, fallback_units)
+
+
+def record_shard_scan(
+    shard: int,
+    num_shards: int,
+    partitions_local: int,
+    partitions_max: int,
+    partitions_total: int,
+    merge_bytes: int,
+    rows_local: int,
+) -> None:
+    _counters.record_shard_scan(
+        shard,
+        num_shards,
+        partitions_local,
+        partitions_max,
+        partitions_total,
+        merge_bytes,
+        rows_local,
+    )
 
 
 def pad_to(arr: np.ndarray, size: int) -> np.ndarray:

@@ -4,10 +4,16 @@ from deequ_tpu.parallel.distributed import (
     data_mesh,
     run_distributed_analysis,
 )
+from deequ_tpu.parallel.multihost import run_sharded_analysis
+from deequ_tpu.parallel.shard import ShardAssignment, ShardPlan, plan_shards
 
 __all__ = [
     "DistributedScanPass",
+    "ShardAssignment",
+    "ShardPlan",
     "data_mesh",
     "multihost",
+    "plan_shards",
     "run_distributed_analysis",
+    "run_sharded_analysis",
 ]

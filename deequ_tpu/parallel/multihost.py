@@ -21,10 +21,19 @@ is irrelevant to scan throughput; this is the same property that makes
 
 Usage on an N-host pod / CPU fleet:
 
+    from deequ_tpu.data.source import PartitionedParquetSource
     from deequ_tpu.parallel import multihost
     multihost.initialize(coordinator_address="host0:1234",
                          num_processes=N, process_id=rank)
-    context = multihost.run_multihost_analysis(my_local_partition, analyzers)
+    source = PartitionedParquetSource(partition_paths)
+    context = multihost.run_sharded_analysis(source, analyzers)
+
+`run_sharded_analysis` (ISSUE 15) shards the dataset's PARTITIONS over
+processes with a rendezvous hash, streams each shard through the full
+solo scan path (state cache included), and all-merges per-partition
+state envelopes in one gather — bit-identical to a solo run at any
+shard count. The older `run_multihost_analysis` (deprecated) instead
+takes this process's partition as an in-memory Table.
 
 Single-process (jax.process_count() == 1) this degrades to a plain local
 run, so the same program runs unchanged from a laptop to a pod.
@@ -32,7 +41,8 @@ run, so the same program runs unchanged from a laptop to a pod.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+import warnings
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -219,6 +229,389 @@ def _merge_host_envelopes(analyzers, host_envelopes, digest, merged, errors):
             merged.persist(analyzer, other if prev is None else prev.merge(other))
 
 
+def run_sharded_analysis(
+    source,
+    analyzers: Sequence[Analyzer],
+    *,
+    shard: Optional[int] = None,
+    num_shards: Optional[int] = None,
+    exclude: Sequence[int] = (),
+    state_repository=None,
+    dataset_name: str = "default",
+    engine: str = "auto",
+    mesh=None,
+    gather=allgather_bytes,
+    controller=None,
+    cancel_token=None,
+    batch_size: Optional[int] = None,
+) -> AnalyzerContext:
+    """The sharded streaming scan (ISSUE 15 tentpole): every process
+    folds ITS OWN deterministic slice of a `PartitionedParquetSource`
+    through the full streamed path (native reader read-ahead,
+    decode->wire fusion, backpressured pipeline, per-partition state
+    commits), then all processes exchange per-partition `DQST` state
+    envelopes in ONE allgather and fold the semigroup in GLOBAL
+    partition order — only states ever cross DCN, never rows.
+
+    Bit-identity contract: every partition's states are produced by the
+    same `scan_partition` sub-scan a solo `_run_partitioned` pass runs,
+    committed under the same `(dataset, plan signature, fingerprint)`
+    keys, and merged in the same global partition order — so a sharded
+    run at ANY shard count is bit-identical to a solo run, the caches
+    interoperate, and either can resume the other (pinned by
+    tests/test_sharded_scan.py across fuzzed shard counts/placements).
+
+    Crash/straggler handling falls out of the state cache: a shard
+    whose envelope is missing or defective (host loss — chaos point
+    `shard.host_loss`) loses nothing globally; every surviving shard
+    recovers its partitions from the committed states in
+    `state_repository`, rescanning only what the lost host had not yet
+    committed (the `shard.merge` chaos point corrupts a single
+    partition entry the same way).
+
+    Cancellation (`controller` + optional cross-process
+    `cancel_token`): a cancel never unwinds PAST the collective — the
+    cancelled shard stops scanning at a partition boundary, still
+    gathers an envelope flagged cancelled (with whatever it committed),
+    and every shard raises `RunCancelled` uniformly after the exchange,
+    so no process is left waiting in a dead collective. A later rerun
+    resumes from the committed partitions.
+
+    `shard`/`num_shards` default to `jax.process_index()` /
+    `jax.process_count()`; `exclude` re-plans around lost shards;
+    `gather` is injectable so an N-shard run is testable in-process.
+    Non-scan-shareable analyzers (grouping, non-shareable scanning) run
+    over this shard's partition subset and merge through
+    `merge_states_across_hosts` — a second gather, approximation
+    contracts unchanged."""
+    from deequ_tpu.analyzers.base import Preconditions, ScanShareableAnalyzer
+    from deequ_tpu.analyzers.grouping import GroupingAnalyzer
+    from deequ_tpu.core.controller import RunCancelled
+    from deequ_tpu.core.exceptions import (
+        EmptyStateException,
+        MetricCalculationException,
+    )
+    from deequ_tpu.core.metrics import Metric
+    from deequ_tpu.ops import runtime
+    from deequ_tpu.ops.fused import scan_partition
+    from deequ_tpu.repository.states import (
+        StateDecodeError,
+        decode_shard_states,
+        decode_states,
+        encode_shard_states,
+        encode_states,
+        merge_states,
+        plan_signature_for,
+    )
+    from deequ_tpu.runners.analysis_runner import AnalysisRunner
+    from deequ_tpu.testing import faults
+
+    analyzers = _dedup(analyzers)
+    if shard is None:
+        shard = jax.process_index()
+    if num_shards is None:
+        num_shards = jax.process_count()
+    shard = int(shard)
+    num_shards = int(num_shards)
+    if not (0 <= shard < num_shards):
+        raise ValueError(f"shard {shard} out of range for {num_shards} shards")
+
+    # preconditions against the FULL dataset schema — identical on every
+    # shard, so all shards agree on which analyzers run (the gathered
+    # envelopes decode positionally against that shared list)
+    passed: List[Analyzer] = []
+    failure_map: Dict[Analyzer, Metric] = {}
+    for a in analyzers:
+        err = Preconditions.find_first_failing(source, a.preconditions())
+        if err is None:
+            passed.append(a)
+        else:
+            failure_map[a] = a.to_failure_metric(err)
+
+    shareable = [
+        a
+        for a in passed
+        if isinstance(a, ScanShareableAnalyzer)
+        and not isinstance(a, GroupingAnalyzer)
+    ]
+    rest = [a for a in passed if a not in shareable]
+
+    from deequ_tpu.parallel.shard import plan_shards
+
+    all_parts = list(source.partitions())
+    parts_by_name = {p.name: p for p in all_parts}
+    plan = plan_shards(all_parts, num_shards, exclude=exclude)
+    mine = plan.assignment(shard)
+
+    ctl = controller
+    if ctl is not None and cancel_token is not None:
+        ctl.bind_shared_cancel(cancel_token)
+
+    repo = state_repository if runtime.state_cache_enabled() else None
+    metrics: Dict[Analyzer, Metric] = {}
+    merge_bytes = 0
+
+    if shareable:
+        signature = plan_signature_for(shareable, source, batch_size)
+        entries: List[tuple] = []
+        #: states of partitions this shard scanned but could not ship
+        #: (an analyzer errored): recovery consults this before a
+        #: second local rescan
+        local_states_by_fp: Dict[str, List] = {}
+        scan_errors: Dict[Analyzer, BaseException] = {}
+        cancelled = False
+        cancel_reason = ""
+        cached_n = 0
+        scanned_n = 0
+
+        def _scan_one(part):
+            """One partition through the solo sub-scan path; commits to
+            the repository when clean. Returns (states, pairs, clean)."""
+            results = scan_partition(
+                shareable, part, batch_size=batch_size, controller=ctl
+            )
+            for a, r in zip(shareable, results):
+                if r.error is not None and a not in scan_errors:
+                    scan_errors[a] = r.error
+            clean = all(r.error is None for r in results)
+            pairs = [
+                (r.analyzer, r.state if r.error is None else None)
+                for r in results
+            ]
+            if repo is not None and clean:
+                with observe.span(
+                    "state_cache", cat="cache", op="save", partition=part.name
+                ):
+                    repo.save_states(
+                        dataset_name, part.fingerprint, signature, pairs
+                    )
+            return [r.state if r.error is None else None for r in results], pairs, clean
+
+        for part in (parts_by_name[n] for n in mine.names):
+            try:
+                if ctl is not None:
+                    ctl.check(
+                        where=f"shard {shard} partition {part.name}",
+                        progress={
+                            "shard": shard,
+                            "partitions_done": cached_n + scanned_n,
+                            "partitions_total": mine.num_partitions,
+                            "partitions_cached": cached_n,
+                        },
+                        boundary=True,
+                    )
+                states = None
+                if repo is not None:
+                    sp = observe.span(
+                        "state_cache", cat="cache", op="load",
+                        partition=part.name,
+                    )
+                    with sp:
+                        states = repo.load_states(
+                            dataset_name, part.fingerprint, signature,
+                            shareable,
+                        )
+                        if sp:
+                            sp.set(hit=states is not None)
+                if states is not None:
+                    # resume from committed progress: re-encoding decoded
+                    # states reproduces the committed envelope bytes
+                    # (state serde round-trips bit-exactly)
+                    entries.append(
+                        (part.fingerprint,
+                         encode_states(list(zip(shareable, states))))
+                    )
+                    cached_n += 1
+                else:
+                    states, pairs, clean = _scan_one(part)
+                    scanned_n += 1
+                    if clean:
+                        entries.append((part.fingerprint, encode_states(pairs)))
+                    else:
+                        # an errored partition never ships: every shard
+                        # rescans it locally and observes the failure
+                        # itself, so the error can't silently drop out
+                        local_states_by_fp[part.fingerprint] = states
+            except RunCancelled as rc:
+                # do NOT unwind past the collective: flag the envelope,
+                # gather, and raise uniformly after the exchange
+                cancelled = True
+                cancel_reason = rc.reason
+                if cancel_token is not None:
+                    cancel_token.trip(rc.reason)
+                break
+
+        envelope = encode_shard_states(
+            shard, signature, entries,
+            cancelled=cancelled, reason=cancel_reason,
+        )
+        lost_directive = faults.fault_point("shard.host_loss")
+        with observe.span(
+            "shard_allgather", cat="transfer", shard=shard,
+            shards=num_shards, envelope_bytes=len(envelope),
+        ):
+            shard_envelopes = list(gather(envelope))
+        if lost_directive == "lost":
+            # chaos: one host's contribution vanishes after the exchange
+            shard_envelopes[(shard + 1) % len(shard_envelopes)] = b""
+        merge_bytes = sum(len(e) for e in shard_envelopes)
+
+        decoded = []
+        for i, env in enumerate(shard_envelopes):
+            try:
+                decoded.append(decode_shard_states(env))
+            except StateDecodeError as e:
+                warnings.warn(
+                    f"DQ320: shard envelope {i} is unusable ({e}); its "
+                    "partitions fall back to committed states or a rescan",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        for env in decoded:
+            if env.signature != signature:
+                raise ValueError(
+                    "sharded-scan plan-signature mismatch: shard "
+                    f"{env.shard} folded under {env.signature!r}, this "
+                    f"shard under {signature!r}; all shards must run "
+                    "identical plans over the same runtime knobs."
+                )
+        remote_cancel = next(
+            ((e.reason or "cancelled") for e in decoded if e.cancelled), None
+        )
+        if cancelled or remote_cancel is not None:
+            if cancel_token is not None:
+                cancel_token.trip(cancel_reason or remote_cancel)
+            raise RunCancelled(
+                cancel_reason or remote_cancel,
+                where=f"shard {shard}",
+                progress={
+                    "shard": shard,
+                    "partitions_done": cached_n + scanned_n,
+                    "partitions_total": mine.num_partitions,
+                },
+            )
+
+        blob_by_fp: Dict[str, bytes] = {}
+        for env in decoded:
+            for fp, blob in env.entries:
+                blob_by_fp.setdefault(fp, blob)
+
+        merged: List = [None] * len(shareable)
+        recovered_n = 0
+        with observe.span(
+            "shard_merge", cat="merge", shard=shard,
+            shards=len(shard_envelopes), partitions=len(plan.order),
+        ):
+            # GLOBAL partition order — the same order a solo
+            # `_run_partitioned` merges in, which is the whole
+            # bit-identity argument (float merge order is the contract)
+            for name, _path, fp in plan.order:
+                states = None
+                blob = blob_by_fp.get(fp)
+                if blob is not None:
+                    directive = faults.fault_point("shard.merge")
+                    if directive == "corrupt":
+                        blob = blob[:-1]
+                    try:
+                        states = decode_states(blob, shareable)
+                    except StateDecodeError as e:
+                        warnings.warn(
+                            f"DQ320: gathered states for partition "
+                            f"{name!r} are unusable ({e}); falling back "
+                            "to committed states or a rescan",
+                            RuntimeWarning,
+                            stacklevel=2,
+                        )
+                        states = None
+                if states is None:
+                    # lost-host / corrupt-entry recovery: committed
+                    # progress first, then a local rescan — the same
+                    # fold either way, so the result is bit-identical
+                    recovered_n += 1
+                    states = local_states_by_fp.get(fp)
+                    if states is None and repo is not None:
+                        states = repo.load_states(
+                            dataset_name, fp, signature, shareable
+                        )
+                    if states is None:
+                        states, _pairs, _clean = _scan_one(parts_by_name[name])
+                        scanned_n += 1
+                merged = [merge_states(m, s) for m, s in zip(merged, states)]
+
+        for a, state in zip(shareable, merged):
+            if a in scan_errors:
+                metrics[a] = a.to_failure_metric(scan_errors[a])
+            else:
+                metrics[a] = a.compute_metric_from(state)
+        runtime.record_state_cache(cached_n, scanned_n, mine.num_partitions)
+
+    if rest:
+        local_provider = InMemoryStateProvider()
+        local_errors: Dict[Analyzer, object] = {}
+        rest_cancel = None
+        if mine.num_partitions:
+            try:
+                local_context = AnalysisRunner.do_analysis_run(
+                    source.subset(list(mine.paths)),
+                    rest,
+                    save_states_with=local_provider,
+                    engine=engine,
+                    mesh=mesh,
+                    controller=ctl,
+                )
+                local_errors = {
+                    a: metric.value.exception
+                    for a, metric in local_context.metric_map.items()
+                    if metric.value.is_failure
+                    and not isinstance(metric.value.exception, EmptyStateException)
+                }
+            except RunCancelled as rc:
+                # same no-unwind-past-the-collective rule: contribute a
+                # per-analyzer failure so other shards fail these
+                # metrics loudly instead of shrinking them silently
+                rest_cancel = rc
+                if cancel_token is not None:
+                    cancel_token.trip(rc.reason)
+                local_errors = {
+                    a: f"shard {shard} cancelled: {rc.reason}" for a in rest
+                }
+        merged_rest, rest_errors = merge_states_across_hosts(
+            rest, local_provider, gather=gather, local_errors=local_errors
+        )
+        if rest_cancel is not None:
+            raise rest_cancel
+        for a in rest:
+            if a in rest_errors:
+                metrics[a] = a.to_failure_metric(
+                    MetricCalculationException(rest_errors[a])
+                )
+            else:
+                metrics[a] = a.compute_metric_from(merged_rest.load(a))
+
+    rows_local = 0
+    if mine.num_partitions:
+        import pyarrow.parquet as pq
+
+        for path in mine.paths:
+            pf = pq.ParquetFile(path)
+            try:
+                rows_local += int(pf.metadata.num_rows)
+            finally:
+                pf.close()
+    runtime.record_shard_scan(
+        shard,
+        num_shards,
+        mine.num_partitions,
+        plan.max_partitions,
+        len(plan.order),
+        merge_bytes,
+        rows_local,
+    )
+
+    metrics.update(failure_map)
+    return AnalyzerContext(metrics)
+
+
 def run_multihost_analysis(
     local_table: Table,
     analyzers: Sequence[Analyzer],
@@ -227,7 +620,14 @@ def run_multihost_analysis(
     gather=allgather_bytes,
     save_states_with=None,
 ) -> AnalyzerContext:
-    """Analyze this process's partition locally, then merge states across
+    """DEPRECATED (ISSUE 15): the Table-only entry point — this
+    process's partition must already sit in memory, so the full
+    streamed path (native reader, decode->wire fusion, pipeline, state
+    cache) never runs. Use `run_sharded_analysis` with a
+    `PartitionedParquetSource`; this shim stays so existing callers
+    keep working and now warns.
+
+    Analyze this process's partition locally, then merge states across
     all processes; returns identical table-level metrics on every host
     (the distributed form of runOnAggregatedStates,
     reference: examples/UpdateMetricsOnPartitionedDataExample.scala:30-95).
@@ -247,6 +647,13 @@ def run_multihost_analysis(
     A failure on ANY host fails that analyzer's global metric on EVERY
     host — a partition that errored must not silently drop out of a
     "successful" table-level number."""
+    warnings.warn(
+        "run_multihost_analysis is deprecated: it takes an in-memory Table "
+        "and bypasses the streamed scan path. Use run_sharded_analysis with "
+        "a PartitionedParquetSource instead.",
+        DeprecationWarning,
+        stacklevel=2,
+    )
     from deequ_tpu.core.exceptions import MetricCalculationException
     from deequ_tpu.runners.analysis_runner import AnalysisRunner
 

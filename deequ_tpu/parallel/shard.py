@@ -1,0 +1,155 @@
+"""Deterministic partition->shard planning for the sharded streaming scan.
+
+The sharded scan (parallel/multihost.py:run_sharded_analysis) gives each
+process a range of a `PartitionedParquetSource`'s partitions to fold
+locally; only the folded states ever cross process boundaries. The
+assignment here is the contract that makes that safe:
+
+  * DETERMINISTIC — every process computes the same plan from the same
+    partition list with no coordination round: the owner of a partition
+    is a pure function of its content fingerprint
+    (`data/source.py:partition_fingerprint`, the same key the state
+    cache stores envelopes under) and the shard count.
+  * MINIMAL MOVEMENT — ownership is a rendezvous (highest-random-weight)
+    hash: each (fingerprint, shard) pair hashes to an independent
+    weight and the live shard with the highest weight owns the
+    partition. Removing a shard therefore moves ONLY the partitions it
+    owned (each to its runner-up shard), and adding one steals only the
+    partitions it now wins — no global reshuffle, so a membership
+    change invalidates the minimum amount of committed per-partition
+    progress.
+  * ORDER-PRESERVING — within a shard, partitions keep their global
+    (dataset name) order, and the plan records the full global order:
+    the merge side folds states in THAT order, which is what keeps a
+    sharded run bit-identical to a solo `_run_partitioned` pass (float
+    merge order is the contract, ops/fused.py).
+
+Host loss is re-planning with the lost shard in `exclude`: its
+partitions land on the surviving shards, which rescan anything the lost
+host had not committed to the StateRepository — from committed
+progress, bit-identically (pinned by tests/test_sharded_scan.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+from deequ_tpu.testing import faults
+
+
+def rendezvous_weight(fingerprint: str, shard: int) -> int:
+    """The (partition, shard) rendezvous weight: the first 8 bytes of
+    sha256("<fingerprint>:<shard>") as a big-endian integer. Pure in its
+    two arguments — no shard ever influences another's weights, which is
+    what bounds re-assignment under membership change."""
+    digest = hashlib.sha256(f"{fingerprint}:{shard}".encode("utf-8")).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+@dataclass(frozen=True)
+class ShardAssignment:
+    """One shard's slice of the dataset, in global partition order."""
+
+    shard: int
+    names: Tuple[str, ...]
+    paths: Tuple[str, ...]
+    fingerprints: Tuple[str, ...]
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.names)
+
+
+@dataclass(frozen=True)
+class ShardPlan:
+    """The full deterministic assignment: one `ShardAssignment` per
+    shard id (excluded/empty shards get empty assignments, so indexing
+    is always total), plus the global partition order the merge side
+    folds in."""
+
+    num_shards: int
+    assignments: Tuple[ShardAssignment, ...]
+    #: (name, path, fingerprint) for EVERY partition, in dataset order —
+    #: the one merge order all shards share
+    order: Tuple[Tuple[str, str, str], ...]
+
+    def assignment(self, shard: int) -> ShardAssignment:
+        return self.assignments[shard]
+
+    def owner_of(self, name: str) -> int:
+        for a in self.assignments:
+            if name in a.names:
+                return a.shard
+        raise KeyError(name)
+
+    @property
+    def max_partitions(self) -> int:
+        return max(a.num_partitions for a in self.assignments)
+
+    @property
+    def min_partitions(self) -> int:
+        live = [a.num_partitions for a in self.assignments if a.num_partitions]
+        return min(live) if live else 0
+
+    @property
+    def skew(self) -> float:
+        """max shard size over the ideal (total/num_shards) — 1.0 is a
+        perfectly even split; the `engine.shard.skew_ratio` telemetry
+        series and the EXPLAIN `shards:` line both report this."""
+        total = len(self.order)
+        if total == 0 or self.num_shards == 0:
+            return 1.0
+        ideal = total / float(self.num_shards)
+        return self.max_partitions / ideal if ideal > 0 else 1.0
+
+
+def plan_shards(
+    partitions: Sequence,
+    num_shards: int,
+    exclude: Sequence[int] = (),
+) -> ShardPlan:
+    """Assign `partitions` (objects with `.name` / `.path` /
+    `.fingerprint`, already in dataset order) to `num_shards` shards by
+    rendezvous hash over the fingerprints. Shards in `exclude` (lost
+    hosts) receive nothing; their partitions fall to the highest-weight
+    survivor — and ONLY theirs move."""
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    excluded = set(int(s) for s in exclude)
+    alive = [s for s in range(num_shards) if s not in excluded]
+    if not alive:
+        raise ValueError(
+            f"all {num_shards} shards excluded — nothing can own the data"
+        )
+    faults.fault_point("shard.assign")
+    owned: Dict[int, List] = {s: [] for s in range(num_shards)}
+    order: List[Tuple[str, str, str]] = []
+    for part in partitions:
+        fingerprint = part.fingerprint
+        order.append((part.name, part.path, fingerprint))
+        # ties broken by shard id so the plan is total even under a
+        # (vanishingly unlikely) weight collision
+        owner = max(alive, key=lambda s: (rendezvous_weight(fingerprint, s), s))
+        owned[owner].append(part)
+    assignments = tuple(
+        ShardAssignment(
+            shard=s,
+            names=tuple(p.name for p in owned[s]),
+            paths=tuple(p.path for p in owned[s]),
+            fingerprints=tuple(p.fingerprint for p in owned[s]),
+        )
+        for s in range(num_shards)
+    )
+    return ShardPlan(
+        num_shards=num_shards, assignments=assignments, order=tuple(order)
+    )
+
+
+__all__ = [
+    "ShardAssignment",
+    "ShardPlan",
+    "plan_shards",
+    "rendezvous_weight",
+]

@@ -90,6 +90,7 @@ def plan_signature(
     compute_dtype: str,
     batch_size: Optional[int],
     batch_rows: Optional[int],
+    variant: str = "",
 ) -> str:
     """Hash of every knob that changes the fold arithmetic of a fused
     pass: the analyzer reprs IN PASS ORDER, the placement mode, the
@@ -97,7 +98,10 @@ def plan_signature(
     source's per-batch row cap, and the serde version. Deliberately
     EXCLUDED: pipeline/pushdown/decode/wire knobs — the differential
     suites prove those bit-identical, so toggling them must not evict
-    the cache."""
+    the cache. `variant` names a fold-arithmetic variant that is NOT
+    bit-identical to the default (today: "pallas-folds", the on-TPU
+    blocked Pallas moments fold) — the empty default leaves signatures
+    unchanged."""
     h = _DIGEST()
     h.update(STATE_MAGIC)
     h.update(struct.pack(">I", STATE_FORMAT_VERSION))
@@ -105,6 +109,8 @@ def plan_signature(
     h.update(str(compute_dtype).encode("utf-8") + b"\x00")
     h.update(str(batch_size).encode("utf-8") + b"\x00")
     h.update(str(batch_rows).encode("utf-8") + b"\x00")
+    if variant:
+        h.update(b"variant:" + variant.encode("utf-8") + b"\x00")
     for a in analyzers:
         h.update(repr(a).encode("utf-8") + b"\x00")
     return h.hexdigest()[:32]
@@ -129,6 +135,7 @@ def plan_signature_for(
         compute_dtype=np.dtype(runtime.compute_dtype()).name,
         batch_size=batch_size,
         batch_rows=int(batch_rows) if batch_rows else None,
+        variant=runtime.fold_variant(),
     )
 
 
@@ -222,6 +229,131 @@ def decode_states(blob: bytes, analyzers: Sequence[Any]) -> List[Any]:
                 f"payload for {analyzer!r} does not decode: {e}"
             ) from e
     return out
+
+
+# -- shard envelope (sharded streaming scan, parallel/multihost.py) ----------
+
+#: magic for a SHARD's gathered contribution: a bag of per-partition
+#: DQST envelopes plus the shard's cancel status. Versioned separately
+#: from DQST — the inner blobs carry their own version and digest.
+SHARD_MAGIC = b"DQSH"
+SHARD_FORMAT_VERSION = 1
+
+
+@dataclass
+class ShardEnvelope:
+    """One shard's decoded contribution to the cross-process all-merge:
+    which shard, under which plan signature, whether it was cancelled
+    (and why), and its `(partition fingerprint, DQST envelope)` entries
+    in that shard's partition order."""
+
+    shard: int
+    signature: str
+    cancelled: bool
+    reason: str
+    entries: List[Tuple[str, bytes]]
+
+
+def encode_shard_states(
+    shard: int,
+    signature: str,
+    entries: Sequence[Tuple[str, bytes]],
+    *,
+    cancelled: bool = False,
+    reason: str = "",
+) -> bytes:
+    """Serialize one shard's per-partition state envelopes for the
+    cross-process allgather:
+
+        DQSH | version u32 | shard u32 | flags u8 (bit0 = cancelled) |
+          reason_len u32 | reason utf8 | sig_len u32 | signature utf8 |
+          count u32 | ( fp_len u32 | fingerprint utf8 |
+                        blob_len u32 | DQST blob )*
+        | sha256(previous bytes)
+
+    Each entry's blob is a complete self-validated `encode_states`
+    envelope — byte-identical to what the shard committed to the
+    StateRepository, so the receiving merge decodes partitions exactly
+    as a solo resume would load them. The cancelled flag is how a
+    cancel crosses the collective WITHOUT deadlocking it: a cancelled
+    shard still gathers (an envelope with whatever it committed), and
+    every shard raises uniformly after the exchange."""
+    body = bytearray()
+    body += SHARD_MAGIC
+    body += struct.pack(">I", SHARD_FORMAT_VERSION)
+    body += struct.pack(">I", int(shard))
+    body += struct.pack(">B", 1 if cancelled else 0)
+    reason_b = reason.encode("utf-8")
+    body += struct.pack(">I", len(reason_b)) + reason_b
+    sig_b = signature.encode("utf-8")
+    body += struct.pack(">I", len(sig_b)) + sig_b
+    body += struct.pack(">I", len(entries))
+    for fingerprint, blob in entries:
+        fp_b = fingerprint.encode("utf-8")
+        body += struct.pack(">I", len(fp_b)) + fp_b
+        body += struct.pack(">I", len(blob)) + blob
+    return bytes(body) + _DIGEST(bytes(body)).digest()
+
+
+def decode_shard_states(blob: bytes) -> ShardEnvelope:
+    """Inverse of `encode_shard_states`, validated end to end like
+    `decode_states`. Any defect raises `StateDecodeError` — the caller
+    treats the whole envelope as a lost host and recovers its partitions
+    from the StateRepository or by rescanning."""
+    header = len(SHARD_MAGIC)
+    if len(blob) < header + 8 + _DIGEST_LEN:
+        raise StateDecodeError("truncated shard envelope")
+    body, digest = blob[:-_DIGEST_LEN], blob[-_DIGEST_LEN:]
+    if _DIGEST(body).digest() != digest:
+        raise StateDecodeError("shard envelope digest mismatch")
+    if body[:header] != SHARD_MAGIC:
+        raise StateDecodeError("bad shard magic")
+    off = header
+    try:
+        version, shard = struct.unpack_from(">II", body, off)
+        off += 8
+        if version != SHARD_FORMAT_VERSION:
+            raise StateDecodeError(
+                f"shard format version {version} != {SHARD_FORMAT_VERSION}"
+            )
+        (flags,) = struct.unpack_from(">B", body, off)
+        off += 1
+        (reason_len,) = struct.unpack_from(">I", body, off)
+        off += 4
+        reason = body[off : off + reason_len].decode("utf-8")
+        off += reason_len
+        (sig_len,) = struct.unpack_from(">I", body, off)
+        off += 4
+        signature = body[off : off + sig_len].decode("utf-8")
+        off += sig_len
+        (count,) = struct.unpack_from(">I", body, off)
+        off += 4
+        entries: List[Tuple[str, bytes]] = []
+        for _ in range(count):
+            (fp_len,) = struct.unpack_from(">I", body, off)
+            off += 4
+            fingerprint = body[off : off + fp_len].decode("utf-8")
+            if len(fingerprint.encode("utf-8")) != fp_len:
+                raise StateDecodeError("truncated shard entry fingerprint")
+            off += fp_len
+            (blob_len,) = struct.unpack_from(">I", body, off)
+            off += 4
+            entry = body[off : off + blob_len]
+            if len(entry) != blob_len:
+                raise StateDecodeError("truncated shard entry payload")
+            off += blob_len
+            entries.append((fingerprint, bytes(entry)))
+    except struct.error as e:
+        raise StateDecodeError(f"truncated shard envelope: {e}") from e
+    if off != len(body):
+        raise StateDecodeError("trailing bytes after last shard entry")
+    return ShardEnvelope(
+        shard=int(shard),
+        signature=signature,
+        cancelled=bool(flags & 1),
+        reason=reason,
+        entries=entries,
+    )
 
 
 def merge_states(a: Any, b: Any) -> Any:
@@ -488,14 +620,19 @@ class StateCacheContext:
 
 
 __all__ = [
+    "SHARD_FORMAT_VERSION",
+    "SHARD_MAGIC",
     "STATE_FORMAT_VERSION",
     "STATE_MAGIC",
     "FileSystemStateRepository",
     "InMemoryStateRepository",
+    "ShardEnvelope",
     "StateCacheContext",
     "StateDecodeError",
     "StateRepository",
+    "decode_shard_states",
     "decode_states",
+    "encode_shard_states",
     "encode_states",
     "merge_states",
     "plan_signature",

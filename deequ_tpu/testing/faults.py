@@ -66,7 +66,8 @@ ENV_KNOB = "DEEQU_TPU_FAULTS"
 #: raises InjectedFaultError), "sleep" (the point blocks for the plan's
 #: stall seconds), "data" (the point returns a directive the call site
 #: applies: read.short -> "short", read.corrupt -> "corrupt",
-#: decode.chunk -> "fail").
+#: decode.chunk -> "fail", shard.merge -> "corrupt",
+#: shard.host_loss -> "lost").
 FAULT_KINDS: Dict[str, str] = {
     # readahead pool / object-store fetch path (data/source.py)
     "read.pread": "raise",     # transient/persistent pread / ranged-GET error
@@ -87,6 +88,10 @@ FAULT_KINDS: Dict[str, str] = {
     "service.scheduler": "sleep",  # the scheduler housekeeping tick wedges
     "service.admission": "raise",  # admission bookkeeping fails mid-submit
     "service.queue": "raise",      # a tier-queue pop fails (corruption)
+    # sharded streaming scan (parallel/shard.py, parallel/multihost.py)
+    "shard.assign": "raise",       # the shard planner fails mid-plan
+    "shard.merge": "data",         # one gathered partition entry corrupts
+    "shard.host_loss": "data",     # a whole shard's envelope is lost
 }
 
 FAULT_POINTS = frozenset(FAULT_KINDS)
@@ -161,6 +166,8 @@ class FaultPlan:
             "read.short": "short",
             "read.corrupt": "corrupt",
             "decode.chunk": "fail",
+            "shard.merge": "corrupt",
+            "shard.host_loss": "lost",
         }[point]
 
 

@@ -170,3 +170,129 @@ class TestHist16RadixSelect:
         assert calls["hist16"] >= 1  # the kernel actually ran
         via_sort = run(False)
         assert via_hist == via_sort, (via_hist, via_sort)
+
+
+class TestMaskedMomentFolds:
+    """ISSUE 15 satellite: the numeric analyzers' count/sum/min/max (+
+    stddev m2) folds as single-HBM-pass pallas kernels, pinned in
+    interpret mode against an identically-blocked XLA reference —
+    BITWISE for every stat (blocked summation is its own arithmetic;
+    that is exactly what the "pallas-folds" plan-signature variant
+    isolates), and exactly for the order-insensitive stats vs the naive
+    fold."""
+
+    @staticmethod
+    def _data(n, seed, all_masked=False):
+        rng = np.random.default_rng(seed)
+        x = jnp.asarray(rng.normal(size=n).astype(np.float32) * 100.0)
+        if all_masked:
+            m = jnp.zeros(n, dtype=jnp.float32)
+        else:
+            m = jnp.asarray((rng.random(n) < 0.8).astype(np.float32))
+        return x, m
+
+    @staticmethod
+    def _blocked_reference(x, m):
+        """The kernel's exact accumulation order in plain jnp ops:
+        (8, 128) lane accumulators over the sequential grid, then the
+        same tiny lane-reduce epilog."""
+        x3 = x.reshape(-1, 8, 128)
+        m3 = m.reshape(-1, 8, 128)
+        cnt = jnp.zeros((8, 128), jnp.float32)
+        tot = jnp.zeros((8, 128), jnp.float32)
+        mn = jnp.full((8, 128), jnp.inf, jnp.float32)
+        mx = jnp.full((8, 128), -jnp.inf, jnp.float32)
+        for blk in range(x3.shape[0]):
+            xb, mb = x3[blk], m3[blk]
+            live = mb > 0
+            cnt = cnt + mb
+            tot = tot + xb * mb
+            mn = jnp.minimum(mn, jnp.where(live, xb, jnp.inf))
+            mx = jnp.maximum(mx, jnp.where(live, xb, -jnp.inf))
+        return jnp.sum(cnt), jnp.sum(tot), jnp.min(mn), jnp.max(mx)
+
+    @pytest.mark.parametrize("n", [1024, 4096, 1 << 14])
+    def test_bitwise_vs_blocked_xla_reference(self, n):
+        x, m = self._data(n, seed=n)
+        got = [np.asarray(v) for v in
+               pallas_kernels.masked_moments(x, m, interpret=True)]
+        ref = [np.asarray(v) for v in self._blocked_reference(x, m)]
+        for g, r in zip(got, ref):
+            assert g.tobytes() == r.tobytes(), (g, r)
+
+    def test_order_insensitive_stats_match_naive_fold_exactly(self):
+        x, m = self._data(4096, seed=3)
+        cnt, total, mn, mx = [
+            np.asarray(v)
+            for v in pallas_kernels.masked_moments(x, m, interpret=True)
+        ]
+        xn, mn_np = np.asarray(x), np.asarray(m)
+        live = xn[mn_np > 0]
+        assert cnt == mn_np.sum()
+        assert mn == live.min()
+        assert mx == live.max()
+        # sums reassociate: allclose, not bitwise, vs the naive fold
+        np.testing.assert_allclose(
+            total, (xn * mn_np).sum(dtype=np.float32), rtol=1e-5
+        )
+
+    def test_all_masked_yields_identities(self):
+        x, m = self._data(1024, seed=5, all_masked=True)
+        cnt, total, mn, mx = [
+            np.asarray(v)
+            for v in pallas_kernels.masked_moments(x, m, interpret=True)
+        ]
+        assert cnt == 0.0 and total == 0.0
+        assert mn == np.inf and mx == -np.inf
+
+    def test_centered_sumsq_matches_stddev_fold(self):
+        x, m = self._data(2048, seed=11)
+        xn, mm = np.asarray(x), np.asarray(m)
+        avg = np.float32((xn * mm).sum() / mm.sum())
+        got = np.asarray(
+            pallas_kernels.masked_centered_sumsq(x, m, avg, interpret=True)
+        )
+        naive = (((xn - avg) * mm) ** 2).sum(dtype=np.float32)
+        np.testing.assert_allclose(got, naive, rtol=1e-5)
+
+    def test_gate_is_off_on_cpu(self, monkeypatch):
+        # even with the knob on, usable() is False on CPU: the fold
+        # returns None and fold_variant stays "" — cached states on CPU
+        # never carry the pallas variant
+        from deequ_tpu.ops import runtime
+
+        monkeypatch.setenv("DEEQU_TPU_PALLAS_FOLDS", "1")
+        x, m = self._data(1024, seed=1)
+        assert pallas_kernels.fold_moments_or_none(x, m) is None
+        assert runtime.fold_variant() == ""
+
+    def test_gate_rejects_unsupported_shapes(self, monkeypatch):
+        monkeypatch.setenv("DEEQU_TPU_PALLAS_FOLDS", "1")
+        x, m = self._data(1024, seed=1)
+        assert pallas_kernels.fold_moments_or_none(x[:100], m[:100]) is None
+
+    def test_knob_off_disables_fold(self, monkeypatch):
+        monkeypatch.setenv("DEEQU_TPU_PALLAS_FOLDS", "0")
+        from deequ_tpu.ops import runtime
+
+        assert not runtime.pallas_folds_enabled()
+        x, m = self._data(1024, seed=1)
+        assert pallas_kernels.fold_moments_or_none(x, m) is None
+
+    def test_fold_variant_enters_plan_signature(self):
+        from deequ_tpu.analyzers.scan import Mean
+        from deequ_tpu.repository.states import plan_signature
+
+        base = plan_signature([Mean("x")], placement="device",
+                              compute_dtype="float32", batch_size=None,
+                              batch_rows=None)
+        default = plan_signature([Mean("x")], placement="device",
+                                 compute_dtype="float32", batch_size=None,
+                                 batch_rows=None, variant="")
+        pallas = plan_signature([Mean("x")], placement="device",
+                                compute_dtype="float32", batch_size=None,
+                                batch_rows=None, variant="pallas-folds")
+        # empty variant leaves existing signatures unchanged; the pallas
+        # arithmetic gets its own cache namespace
+        assert base == default
+        assert pallas != base

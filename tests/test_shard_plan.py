@@ -1,0 +1,124 @@
+"""Shard planner (parallel/shard.py): deterministic rendezvous
+assignment over partition fingerprints — every shard computes the same
+plan independently, membership change moves the minimum number of
+partitions, and the global merge order is preserved."""
+
+from __future__ import annotations
+
+import pytest
+
+from deequ_tpu.parallel.shard import (
+    ShardPlan,
+    plan_shards,
+    rendezvous_weight,
+)
+from deequ_tpu.testing import faults
+
+
+class FakePartition:
+    def __init__(self, i):
+        self.name = f"part-{i:03d}.parquet"
+        self.path = f"/data/{self.name}"
+        self.fingerprint = f"fp-{i:03d}-{i * 2654435761 % 997:x}"
+
+
+def parts(n):
+    return [FakePartition(i) for i in range(n)]
+
+
+class TestPlanShards:
+    def test_every_partition_assigned_exactly_once(self):
+        plan = plan_shards(parts(23), 4)
+        seen = []
+        for k in range(4):
+            seen.extend(plan.assignment(k).names)
+        assert sorted(seen) == [p.name for p in parts(23)]
+
+    def test_deterministic_across_processes(self):
+        # every process plans independently; identical inputs must yield
+        # identical plans (this IS the coordination mechanism)
+        a = plan_shards(parts(31), 5)
+        b = plan_shards(parts(31), 5)
+        assert a == b
+
+    def test_global_order_preserved(self):
+        plan = plan_shards(parts(12), 3)
+        assert [n for n, _p, _f in plan.order] == [p.name for p in parts(12)]
+        for k in range(3):
+            names = plan.assignment(k).names
+            # each shard's slice keeps dataset order
+            assert list(names) == [
+                n for n, _p, _f in plan.order if n in set(names)
+            ]
+
+    def test_owner_of_matches_assignments(self):
+        plan = plan_shards(parts(17), 3)
+        for k in range(3):
+            for name in plan.assignment(k).names:
+                assert plan.owner_of(name) == k
+
+    def test_minimal_movement_on_exclusion(self):
+        # losing shard 1 must ONLY move shard 1's partitions; everything
+        # owned by a surviving shard stays put (the rendezvous property)
+        ps = parts(40)
+        before = plan_shards(ps, 4)
+        after = plan_shards(ps, 4, exclude=(1,))
+        assert after.assignment(1).names == ()
+        for k in (0, 2, 3):
+            assert set(before.assignment(k).names) <= set(
+                after.assignment(k).names
+            )
+        moved = set(before.assignment(1).names)
+        gained = set()
+        for k in (0, 2, 3):
+            gained |= set(after.assignment(k).names) - set(
+                before.assignment(k).names
+            )
+        assert gained == moved
+
+    def test_skew_is_bounded_and_reported(self):
+        plan = plan_shards(parts(64), 4)
+        assert plan.max_partitions >= 64 // 4
+        assert plan.skew >= 1.0
+        # rendezvous over 64 partitions should not degenerate
+        assert plan.skew < 2.0
+
+    def test_single_shard_owns_everything(self):
+        plan = plan_shards(parts(9), 1)
+        assert plan.assignment(0).num_partitions == 9
+        assert plan.skew == 1.0
+
+    def test_weight_is_stable(self):
+        assert rendezvous_weight("fp-a", 0) == rendezvous_weight("fp-a", 0)
+        assert rendezvous_weight("fp-a", 0) != rendezvous_weight("fp-a", 1)
+
+    def test_rejects_bad_inputs(self):
+        with pytest.raises(ValueError):
+            plan_shards(parts(4), 0)
+        with pytest.raises(ValueError):
+            plan_shards(parts(4), 2, exclude=(0, 1))
+
+    def test_empty_dataset_plans_empty(self):
+        plan = plan_shards([], 3)
+        assert plan.order == ()
+        assert plan.assignment(0).names == ()
+        assert plan.skew == 1.0
+
+    def test_assign_fault_point_raises(self):
+        with faults.install("shard.assign:1"):
+            with pytest.raises(faults.InjectedFaultError):
+                plan_shards(parts(8), 2)
+
+
+class TestShardPlanShape:
+    def test_counts(self):
+        plan = plan_shards(parts(10), 3)
+        total = sum(plan.assignment(k).num_partitions for k in range(3))
+        assert total == 10
+        assert plan.max_partitions == max(
+            plan.assignment(k).num_partitions for k in range(3)
+        )
+        assert plan.min_partitions == min(
+            plan.assignment(k).num_partitions for k in range(3)
+        )
+        assert isinstance(plan, ShardPlan)

@@ -169,6 +169,8 @@ FAULTS_FILES = [
     os.path.join("deequ_tpu", "service", "service.py"),
     os.path.join("deequ_tpu", "service", "admission.py"),
     os.path.join("deequ_tpu", "service", "breaker.py"),
+    os.path.join("deequ_tpu", "parallel", "shard.py"),
+    os.path.join("deequ_tpu", "parallel", "multihost.py"),
 ]
 # The chaos harness's registry: every fault_point("<name>") literal in
 # deequ_tpu/ must be a key of FAULT_KINDS in this module.

@@ -91,6 +91,19 @@ WATCHED_SERIES: Sequence[Tuple[str, str]] = (
     # (tenant, dataset) pairs are repeatedly failing their runs and
     # being fenced off from the pool (corrupt upstream tables)
     ("engine.service.breaker_open", "up"),
+    # sharded-scan per-shard fold throughput: a drop means shards
+    # stopped scaling (straggler host, shrunken readahead, partition
+    # skew starving the mesh)
+    ("engine.shard.rows_per_s", "down"),
+    # sharded-scan balance: the largest shard's partition count over
+    # the even split; a rise means the rendezvous assignment degenerated
+    # (partition count too low for the mesh, exclusions piling up)
+    ("engine.shard.skew_ratio", "up"),
+    # sharded-scan merge traffic: gathered state-envelope bytes crossing
+    # the process boundary; growth means states bloated (HLL/histogram
+    # payloads growing, partition counts exploding) — rows never cross,
+    # so this must stay KB-scale
+    ("engine.shard.merge_bytes", "up"),
 )
 
 #: phases whose share of wall time is watched (rises are bad: a phase
