@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint typecheck analyze sentinel test test-fast trace-demo chaos service-chaos bench-pushdown bench-decode bench-wire bench-incremental bench-reader bench-forensics bench-chaos bench-service bench-mesh clean-native
+.PHONY: lint typecheck analyze sentinel test test-fast trace-demo chaos service-chaos bench-pushdown bench-decode bench-wire bench-incremental bench-reader bench-forensics bench-chaos bench-service bench-mesh bench-sharing clean-native
 
 lint:
 	$(PY) tools/lint.py
@@ -132,6 +132,16 @@ bench-chaos:
 BENCH_MESH_ROWS ?= 128000
 bench-mesh:
 	JAX_PLATFORMS=cpu BENCH_MESH_ROWS=$(BENCH_MESH_ROWS) $(PY) tools/bench_mesh.py
+
+# fleet-wide scan-sharing benchmark (ISSUE 17): 4 co-tenant suites
+# grouped onto ONE proven union scan must finish in <=1.5x a single
+# scan's wall time (vs ~4x independent), with every participant
+# bit-identical to its solo run and every CONTAINED proof pinned at
+# zero drift — the bench ABORTS on any mismatch. Refreshes
+# BENCH_SHARING.json (methodology: BENCH.md round 17)
+BENCH_SHARING_ROWS ?= 8000000
+bench-sharing:
+	JAX_PLATFORMS=cpu BENCH_SHARING_ROWS=$(BENCH_SHARING_ROWS) $(PY) tools/bench_sharing.py
 
 # remove cached native builds (the hash-named .so files): any strays in
 # the package tree from older versions plus the per-user cache dir the

@@ -43,6 +43,12 @@ from deequ_tpu.lint.planlint import (
     validate_plan,
 )
 from deequ_tpu.lint.schema import FieldInfo, SchemaInfo
+from deequ_tpu.lint.subsume import (
+    PlanEnv,
+    SubsumptionProof,
+    prove_subsumption,
+    wheres_equivalent,
+)
 from deequ_tpu.lint.typecheck import TypedExpr, analyze_ast, analyze_expression
 
 __all__ = [
@@ -70,14 +76,18 @@ __all__ = [
     "Interval",
     "PassCost",
     "PlanCost",
+    "PlanEnv",
     "PredicatePrune",
     "PrunePlan",
     "RowGroupStats",
+    "SubsumptionProof",
     "analyze_plan",
     "build_prune_plan",
     "cost_diagnostics",
     "explain",
     "explain_plan",
+    "prove_subsumption",
     "render_explain",
     "scan_effects",
+    "wheres_equivalent",
 ]

@@ -56,6 +56,9 @@ CODES = {
     "DQ317": "forensics audit-trail entry unusable; forensics unavailable",
     "DQ318": "deadline set but the source has no partition boundaries",
     "DQ319": "plan can never be admitted under the tenant's quota window",
+    # fleet-level scan sharing (plan-subsumption prover, lint/subsume.py)
+    "DQ321": "suite provably contained in a shared scan",
+    "DQ322": "scan sharing declined; obligation not provably contained",
 }
 
 

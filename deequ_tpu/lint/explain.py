@@ -448,10 +448,64 @@ def _render_pass(p: PassCost, idx: int) -> List[str]:
     return lines
 
 
+def sharing_diagnostics(
+    proof: Any, analyzers: Sequence[Any] = ()
+) -> List[Diagnostic]:
+    """DQ321/DQ322 over a `lint.subsume.SubsumptionProof` — one DQ321
+    when the suite provably rides a shared scan, else one DQ322 per
+    undischarged obligation with the caret on the offending where."""
+    diags: List[Diagnostic] = []
+    if proof is None:
+        return diags
+    if proof.contained:
+        diags.append(
+            Diagnostic(
+                "DQ321",
+                Severity.WARNING,
+                "suite is provably contained in the candidate shared "
+                f"scan — {proof.summary()}; one superset scan computes "
+                "these metrics bit-identically over the state semigroup",
+            )
+        )
+        return diags
+    for mismatch in proof.env_mismatches:
+        diags.append(
+            Diagnostic(
+                "DQ322",
+                Severity.WARNING,
+                "scan sharing declined: plan environments are "
+                f"incomparable ({mismatch}) — states folded under "
+                "different arithmetic are never merged",
+            )
+        )
+    for obligation in proof.obligations:
+        if obligation.satisfied:
+            continue
+        where = obligation.where
+        diags.append(
+            Diagnostic(
+                "DQ322",
+                Severity.WARNING,
+                "scan sharing declined: "
+                + (obligation.detail or "obligation not provably contained"),
+                source=where,
+                span=(0, len(where)) if where else None,
+                subject=obligation.analyzer,
+            )
+        )
+    return diags
+
+
 def render_explain(
-    cost: PlanCost, diagnostics: Sequence[Diagnostic] = ()
+    cost: PlanCost,
+    diagnostics: Sequence[Diagnostic] = (),
+    sharing: Optional[str] = None,
 ) -> str:
-    """The EXPLAIN report: predicted execution shape, then diagnostics."""
+    """The EXPLAIN report: predicted execution shape, then diagnostics.
+
+    `sharing` — the one-line subsumption-proof summary
+    (`SubsumptionProof.summary()`) when the plan was checked against a
+    candidate shared scan; rendered as the `sharing:` line."""
     head = [
         "== Plan explain (static — no data scanned) ==",
         f"analyzers: {len(cost.analyzers)}   placement: {cost.placement}   "
@@ -516,6 +570,8 @@ def render_explain(
                 else f", quota overdrawn by ~{_fmt_bytes(-headroom)}"
             )
         body.append(line)
+    if sharing is not None:
+        body.append(f"sharing: {sharing}")
     if cost.retry_budget is not None or cost.deadline_s is not None:
         scan = cost.scan_pass
         resume = (
@@ -560,9 +616,17 @@ class ExplainResult:
     # (constraint repr, reason) for the DQ316 fall-offs
     forensics_capable: List[Tuple[str, str]] = field(default_factory=list)
     forensics_falloffs: List[Tuple[str, str]] = field(default_factory=list)
+    # the plan-subsumption proof (lint/subsume.SubsumptionProof) when
+    # the plan was checked against a candidate shared scan; its summary
+    # renders as the `sharing:` line
+    sharing: Optional[Any] = None
 
     def render(self) -> str:
-        text = render_explain(self.cost, self.diagnostics)
+        text = render_explain(
+            self.cost,
+            self.diagnostics,
+            sharing=self.sharing.summary() if self.sharing is not None else None,
+        )
         if self.forensics_capable or self.forensics_falloffs:
             lines = [
                 "failure forensics (with_forensics() / "
@@ -618,6 +682,7 @@ def explain_plan(
     partitions: Optional[Sequence] = None,
     deadline_s: Optional[float] = None,
     quota_scan_bytes: Optional[float] = None,
+    sharing_with: Optional[Sequence[Any]] = None,
 ) -> ExplainResult:
     """EXPLAIN an analysis plan against a `Table` (schema and row count
     are taken from it — still zero data scanned) or a `SchemaInfo`.
@@ -644,7 +709,13 @@ def explain_plan(
 
     `quota_scan_bytes` — a tenant's scan-bytes-per-window budget (the
     DQService admission path supplies it) — adds the quota headroom to
-    the `admission:` line and arms the DQ319 never-admittable lint."""
+    the `admission:` line and arms the DQ319 never-admittable lint.
+
+    `sharing_with` — the analyzer list of a candidate superset scan
+    (another tenant's admitted plan over the same table): runs the
+    plan-subsumption prover (lint/subsume.py) against it, attaches the
+    proof as `result.sharing` (rendered on the `sharing:` line), and
+    arms the DQ321/DQ322 diagnostics."""
     if isinstance(data_or_schema, SchemaInfo):
         schema = data_or_schema
     else:
@@ -698,6 +769,15 @@ def explain_plan(
     diagnostics = cost_diagnostics(
         cost, plan, schema, quota_scan_bytes=quota_scan_bytes
     )
+    sharing_proof = None
+    if sharing_with is not None:
+        try:
+            from deequ_tpu.lint.subsume import prove_subsumption
+
+            sharing_proof = prove_subsumption(plan, list(sharing_with), schema)
+            diagnostics.extend(sharing_diagnostics(sharing_proof, plan))
+        except Exception:  # noqa: BLE001 — the prover is advisory here
+            sharing_proof = None
     # DQ316 — failure-forensics capability, predicted from the SAME
     # static classification the capture itself uses: constraints whose
     # violating rows cannot be identified per batch fall off with the
@@ -733,6 +813,7 @@ def explain_plan(
         diagnostics=diagnostics,
         forensics_capable=capable,
         forensics_falloffs=falloffs,
+        sharing=sharing_proof,
     )
 
 
@@ -756,4 +837,5 @@ __all__ = [
     "explain",
     "explain_plan",
     "render_explain",
+    "sharing_diagnostics",
 ]

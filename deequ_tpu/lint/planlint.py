@@ -389,13 +389,18 @@ def validate_plan(
     row_groups: Optional[Sequence] = None,
     partitions: Optional[Sequence] = None,
     deadline_s: Optional[float] = None,
+    sharing_with: Optional[Sequence] = None,
 ) -> LintReport:
     """Run the full static pass: semantic lints (DQ1xx/DQ2xx) plus the
     cost analyzer's performance lints (DQ3xx, lint/explain.py). The
     computed `PlanCost` is attached as `report.plan_cost`. mode:
     'strict' raises one aggregated PlanValidationError when any
     error-severity diagnostic exists (warnings ride along in it);
-    'lenient' returns the report for the caller to attach; 'off' skips."""
+    'lenient' returns the report for the caller to attach; 'off' skips.
+
+    `sharing_with` — the analyzer list of a candidate superset scan:
+    runs the plan-subsumption prover (lint/subsume.py) and attaches the
+    DQ321/DQ322 sharing diagnostics, exactly like the DQ31x lints."""
     from deequ_tpu.lint.diagnostics import PlanValidationError
 
     if mode == "off":
@@ -418,6 +423,12 @@ def validate_plan(
             deadline_s=deadline_s,
         )
         report.extend(cost_diagnostics(report.plan_cost, plan, schema))
+        if sharing_with is not None:
+            from deequ_tpu.lint.explain import sharing_diagnostics
+            from deequ_tpu.lint.subsume import prove_subsumption
+
+            proof = prove_subsumption(plan, list(sharing_with), schema)
+            report.extend(sharing_diagnostics(proof, plan))
     except Exception:  # noqa: BLE001 — cost lint must never break a run
         report.plan_cost = None
     if mode == "strict" and report.errors:

@@ -185,6 +185,22 @@ def record_shard_scan(
         tracer.count("shard.rows_local", int(rows_local))
 
 
+def record_plan_cache(hit: bool) -> None:
+    """Compiled-plan cache outcome of one fused-fn lookup: whether the
+    jit/fuse cost for this plan *shape* (the analyzer-repr component of
+    `repository.states.plan_signature`, plus wire layout and x64 flag)
+    was already paid by an earlier plan anywhere in the process —
+    fleet-wide under the DQService, where co-tenant suites share plan
+    shapes. Tracer-only, like record_pruned_groups; the counters feed
+    the `engine.plan_cache_hit_ratio` telemetry series the sentinel
+    watches."""
+    tracer = spans.current_tracer()
+    if tracer is not None:
+        tracer.count("plan_cache.lookups", 1)
+        if hit:
+            tracer.count("plan_cache.hits", 1)
+
+
 def record_state_cache(cached: int, scanned: int, total: int) -> None:
     """Partition-split outcome of one partitioned fused scan: partitions
     whose states loaded from the state cache vs partitions that decoded

@@ -228,6 +228,16 @@ def engine_metric_record(
             rec.get("engine.counter.partitions_cached", 0.0) / partitions_total
         )
 
+    # derived: fraction of fused-fn lookups that found their plan
+    # *shape* already compiled (the jit/fuse cost paid once per shape
+    # fleet-wide) — the sentinel watches it dropping; only present when
+    # a fused-fn lookup actually ran
+    plan_lookups = rec.get("engine.counter.plan_cache.lookups", 0.0)
+    if plan_lookups > 0.0:
+        rec["engine.plan_cache_hit_ratio"] = (
+            rec.get("engine.counter.plan_cache.hits", 0.0) / plan_lookups
+        )
+
     # derived: fraction of retried transient-IO operations that
     # recovered within the retry budget (the rest degraded to the
     # pyarrow fallback) — the sentinel watches it dropping; only present

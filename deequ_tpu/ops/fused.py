@@ -83,6 +83,25 @@ def unpack_outputs(packed: np.ndarray, meta):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def plan_shape_key(
+    analyzers: Sequence[ScanShareableAnalyzer],
+    assisted: Sequence[ScanShareableAnalyzer] = (),
+    layout: Any = None,
+) -> Tuple[Any, ...]:
+    """The compiled-plan cache key: the plan-*shape* component of
+    `repository.states.plan_signature` (analyzer reprs in pass order)
+    plus the wire layout and the x64 flag — everything that changes the
+    traced program. Two tenants whose suites reduce to the same shape
+    share one jitted fused fn, so the jit/fuse cost is paid once per
+    shape fleet-wide."""
+    return (
+        tuple(repr(a) for a in analyzers),
+        tuple(repr(a) for a in assisted),
+        layout,
+        bool(jax.config.jax_enable_x64),
+    )
+
+
 def get_fused_fn(
     analyzers: Sequence[ScanShareableAnalyzer],
     assisted: Sequence[ScanShareableAnalyzer] = (),
@@ -95,14 +114,10 @@ def get_fused_fn(
     (k, padded) array whose row i is input `key_i`. Returns (fn, meta_box);
     meta_box['meta'] (filled at trace time) drives unpack_outputs.
     """
-    key = (
-        tuple(repr(a) for a in analyzers),
-        tuple(repr(a) for a in assisted),
-        layout,
-        bool(jax.config.jax_enable_x64),
-    )
+    key = plan_shape_key(analyzers, assisted, layout)
     with _FUSED_CACHE_LOCK:
         cached = _FUSED_CACHE.get(key)
+    runtime.record_plan_cache(cached is not None)
     if cached is None:
         meta_box: Dict[str, Any] = {}
         if layout is None:
@@ -426,6 +441,49 @@ def plan_scan_members(analyzers: Sequence[Any], mode: Optional[str] = None) -> S
         for spec in analyzer_specs:
             plan.specs.setdefault(spec.key, spec)
     return plan
+
+
+def build_union_plan(
+    plans: Sequence[Sequence[Any]],
+) -> Tuple[List[Any], List[List[int]]]:
+    """Union-plan builder for fleet-level scan sharing: merge several
+    suites' analyzer lists into ONE superset fused scan — pure and
+    data-free.
+
+    Analyzers deduplicate by engine identity ((type, repr), the same
+    equality the runner and the state-cache signature use), preserving
+    first-appearance order, so the union's pass order is deterministic
+    in submission order. Returns ``(union, memberships)``:
+    ``union`` is the superset analyzer list, ``memberships[i]`` indexes
+    plan i's (deduplicated, order-preserved) analyzers into ``union``.
+    Each member plan's states fan back out by selecting its rows of the
+    union's results — bit-identical to a solo run, because per-analyzer
+    fold states are independent of which other members ride the pass
+    (the multi-family kernels are proven batched-vs-solo identical and
+    partition states merge over the semigroup).
+
+    Equivalent-but-differently-spelled where clauses deliberately stay
+    separate members: each suite's states then fold under its own
+    spelling, keeping the fan-out trivially exact (the prover records
+    such pairs as CONTAINED_WITH_RESIDUAL when asked directly)."""
+    union: List[Any] = []
+    index: Dict[Any, int] = {}
+    memberships: List[List[int]] = []
+    for plan in plans:
+        rows: List[int] = []
+        seen: set = set()
+        for analyzer in plan:
+            if analyzer in seen:
+                continue
+            seen.add(analyzer)
+            pos = index.get(analyzer)
+            if pos is None:
+                pos = len(union)
+                index[analyzer] = pos
+                union.append(analyzer)
+            rows.append(pos)
+        memberships.append(rows)
+    return union, memberships
 
 
 @dataclass(frozen=True)

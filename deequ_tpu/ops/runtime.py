@@ -264,6 +264,36 @@ def state_cache_enabled() -> bool:
     return os.environ.get("DEEQU_TPU_STATE_CACHE", "") not in ("0", "off")
 
 
+def scan_sharing_enabled() -> bool:
+    """Whether the DQService may merge co-tenant submissions over the
+    same dataset fingerprint into ONE superset fused scan (fleet-level
+    scan sharing, service/sharing.py) when the plan-subsumption prover
+    (lint/subsume.py) proves every participant contained.
+
+    `DEEQU_TPU_SCAN_SHARING=0` (or `off`) is the kill switch: every
+    submission scans solo, exactly as before sharing existed — the
+    baseline the sharing differential suite compares against. Metrics
+    are bit-identical either way (the fan-out rides the state
+    semigroup); only how many times the table is read changes."""
+    import os
+
+    return os.environ.get("DEEQU_TPU_SCAN_SHARING", "") not in ("0", "off")
+
+
+def share_group_max() -> int:
+    """Cap on participants in one shared scan
+    (`DEEQU_TPU_SHARE_GROUP_MAX`, default 8): bounds the fan-out a
+    single worker performs and the blast radius of one preemption."""
+    import os
+
+    raw = os.environ.get("DEEQU_TPU_SHARE_GROUP_MAX", "")
+    try:
+        n = int(raw) if raw else 8
+    except ValueError:
+        return 8
+    return max(1, n)
+
+
 def pallas_folds_enabled() -> bool:
     """Whether the numeric moments/min-max state folds may run as
     Pallas kernels (ops/pallas_kernels.py) on platforms that compile
@@ -751,6 +781,10 @@ def record_decode_fastpath(fast: int, total: int, workers: int) -> None:
 
 def record_wire_fused(fused: int, total: int) -> None:
     _counters.record_wire_fused(fused, total)
+
+
+def record_plan_cache(hit: bool) -> None:
+    _counters.record_plan_cache(hit)
 
 
 def record_state_cache(cached: int, scanned: int, total: int) -> None:

@@ -73,6 +73,11 @@ class SubmissionHandle:
         self.cost: Any = None
         self.attempts = 0
         self.preemptions = 0
+        # scan-sharing outcome: None when the run never met a share
+        # group, else {"shared": bool, ...} with the subsumption proof
+        # and its post-execution drift pin (all-zero on a sound share)
+        # or the prover's decline reason
+        self.sharing: Optional[Dict[str, Any]] = None
         self._done = threading.Event()
 
     def done(self) -> bool:
@@ -95,7 +100,7 @@ class _Submission:
     __slots__ = (
         "tenant", "dataset", "data", "checks", "analyzers", "priority",
         "deadline_s", "submitted_at", "handle", "tier", "cost",
-        "controller", "seq", "counted", "engine",
+        "controller", "seq", "counted", "engine", "fingerprint",
     )
 
     def __init__(
@@ -128,6 +133,9 @@ class _Submission:
         self.controller: Optional[RunController] = None
         self.seq = seq
         self.engine = engine
+        # content-based dataset identity for scan sharing; None means
+        # "cannot prove same data" and the run always scans solo
+        self.fingerprint: Optional[str] = None
         # whether this submission currently counts against the
         # tenant's pending budget (decremented exactly once)
         self.counted = True
@@ -289,6 +297,13 @@ class DQService:
             deadline_s, self._clock(), handle, tier, decision.cost,
             next(self._seq), engine,
         )
+        if engine == "single" and runtime.scan_sharing_enabled():
+            from .sharing import dataset_fingerprint
+
+            try:
+                sub.fingerprint = dataset_fingerprint(data, table)
+            except Exception:  # fault-ok: no identity = no sharing
+                sub.fingerprint = None
         with self._cv:
             if not self._accepting:
                 return self._finalize_locked_handle(
@@ -409,6 +424,42 @@ class DQService:
     def _tenant_running_locked(self, tenant: str) -> int:
         return sum(1 for s in self._running if s.tenant == tenant)
 
+    def _collect_share_group_locked(self, lead: _Submission) -> List[_Submission]:
+        """Gather queued submissions provably over the SAME data as
+        ``lead`` (matching dataset fingerprint) into one share group.
+        Peers leave their queues and join ``_running`` immediately —
+        the group occupies ONE worker and runs one superset scan.
+        Tenant concurrency caps count group membership; the group size
+        is bounded by DEEQU_TPU_SHARE_GROUP_MAX."""
+        if (
+            lead.fingerprint is None
+            or lead.engine != "single"
+            or not runtime.scan_sharing_enabled()
+        ):
+            return [lead]
+        group = [lead]
+        limit = runtime.share_group_max()
+        for tier in TIERS:
+            if len(group) >= limit:
+                break
+            q = self._queues[tier]
+            for s in list(q):
+                if len(group) >= limit:
+                    break
+                if s.fingerprint != lead.fingerprint or s.engine != "single":
+                    continue
+                # group members already joined _running, so the usual
+                # concurrency check naturally counts them
+                if self._tenant_running_locked(s.tenant) >= self.ledger.quota(
+                    s.tenant
+                ).max_concurrent:
+                    continue
+                q.remove(s)
+                self._running.append(s)
+                s.handle.status = "running"
+                group.append(s)
+        return group
+
     def _maybe_preempt_locked(self) -> None:
         """An interactive arrival with no idle worker bumps one
         running heavy run (soft cancel — its partition commits)."""
@@ -472,12 +523,17 @@ class DQService:
                         self._cv.wait(timeout=0.1)
                 self._running.append(sub)
                 sub.handle.status = "running"
+                group = self._collect_share_group_locked(sub)
             try:
-                self._execute(sub)
+                if len(group) > 1:
+                    self._execute_shared(group)
+                else:
+                    self._execute(sub)
             finally:
                 with self._cv:
-                    if sub in self._running:
-                        self._running.remove(sub)
+                    for s in group:
+                        if s in self._running:
+                            self._running.remove(s)
                     self._cv.notify_all()
 
     def _execute(self, sub: _Submission) -> None:
@@ -539,6 +595,300 @@ class DQService:
         with self._cv:
             self._decrement_pending_locked(sub)
             self._finalize_locked_handle(handle, "done", None, "")
+
+    # ------------------------------------------------------------------
+    # shared scans (service/sharing.py)
+
+    def _execute_shared(self, group: List[_Submission]) -> None:
+        """Run one share group: prove every member's plan contained in
+        the union plan, run ONE superset scan, and fan the folded
+        states back out to each member's constraint evaluation.
+        Members the prover declines fall back to solo runs on the same
+        worker — sharing is an optimization, never a gate."""
+        from . import sharing
+
+        live: List[_Submission] = []
+        for sub in group:
+            if sub.deadline_s is not None and (
+                (sub.submitted_at + sub.deadline_s) - self._clock() <= 0
+            ):
+                with self._cv:
+                    self._shed_locked(sub, "deadline expired before start")
+                continue
+            live.append(sub)
+        if not live:
+            return
+
+        participants: List[_Submission] = []
+        proofs: List[Any] = []
+        solo: List[_Submission] = []
+        table = None
+        if len(live) > 1:
+            lead = live[0]
+            try:
+                table = lead.data() if callable(lead.data) else lead.data
+                plans = [
+                    sharing.submission_plan(s.checks, s.analyzers)
+                    for s in live
+                ]
+                _union, group_proofs, declines = sharing.plan_share_group(
+                    plans, table
+                )
+            except Exception:  # noqa: BLE001 — prover/broken open never
+                # fails the work: everything just runs solo
+                solo = live
+            else:
+                for sub, proof, decline in zip(live, group_proofs, declines):
+                    if decline is None:
+                        participants.append(sub)
+                        proofs.append(proof)
+                    else:
+                        self.telemetry.count("sharing_declined")
+                        sub.handle.sharing = {
+                            "shared": False,
+                            "reason": decline,
+                        }
+                        solo.append(sub)
+                if len(participants) < 2:
+                    solo = participants + solo
+                    participants, proofs = [], []
+        else:
+            solo = live
+
+        if participants:
+            self._run_shared_scan(participants, proofs, table)
+        for sub in solo:
+            self._execute(sub)
+
+    def _run_shared_scan(
+        self,
+        participants: List[_Submission],
+        proofs: List[Any],
+        table: Any,
+    ) -> None:
+        from ..runners.analysis_runner import AnalysisRunner
+        from ..runners.context import AnalyzerContext
+        from ..verification.suite import VerificationSuite
+        from . import sharing
+
+        self.telemetry.count("shared_scans")
+        for _ in participants:
+            self.telemetry.count("shared_participants")
+
+        plans = [
+            sharing.submission_plan(s.checks, s.analyzers)
+            for s in participants
+        ]
+        union, _memberships = self._union_plan(plans)
+
+        ctl = RunController()
+        overdrawn: set = set()
+        ctl.set_boundary_probe(
+            self._shared_boundary_probe(participants, overdrawn)
+        )
+        with self._cv:
+            for sub in participants:
+                sub.controller = ctl
+                sub.handle.attempts += 1
+
+        fanout_repo = None
+        if self._state_repository is not None:
+            tenants = [
+                sharing.TenantStatePlan(
+                    self._state_dataset(s.tenant, s.dataset), plan, table
+                )
+                for s, plan in zip(participants, plans)
+            ]
+            fanout_repo = sharing.FanoutStateRepository(
+                self._state_repository, tenants
+            )
+
+        captures = None
+        forensics = None
+        if runtime.forensics_enabled():
+            from ..observe.forensics import ForensicsCapture
+
+            captures = [ForensicsCapture(s.checks) for s in participants]
+            forensics = sharing.ForensicsFanout(captures)
+
+        try:
+            faults.fault_point("service.worker")
+            context = AnalysisRunner.do_analysis_run(
+                table,
+                union,
+                engine="single",
+                validation="off",
+                state_repository=fanout_repo,
+                dataset_name=sharing.shared_dataset_name(
+                    participants[0].fingerprint or "anon"
+                ),
+                forensics=forensics,
+                controller=ctl,
+            )
+        except RunCancelled as exc:
+            # one scan, one fate: EVERY participant resumes (preempt /
+            # drain re-queue) or finalizes with the same DQ4xx — never
+            # a partial fan-out
+            for sub in participants:
+                self._on_cancelled(sub, exc)
+            return
+        except Exception as exc:  # noqa: BLE001 — containment, as solo
+            self.telemetry.count("failed")
+            if isinstance(exc, faults.InjectedFaultError):
+                self.telemetry.count("worker_faults")
+            for sub in participants:
+                self.breakers.record_failure(sub.tenant, sub.dataset)
+                sub.handle.error = exc
+                with self._cv:
+                    self._decrement_pending_locked(sub)
+                    self._finalize_locked_handle(
+                        sub.handle, "failed", None,
+                        f"{type(exc).__name__}: {exc}",
+                    )
+                publish_event(
+                    "service.failed", tenant=sub.tenant, dataset=sub.dataset,
+                )
+            return
+
+        executed = [repr(a) for a in context.metric_map]
+        schema = None
+        try:
+            from ..lint import SchemaInfo
+
+            schema = SchemaInfo.from_table(table)
+        except Exception:  # noqa: BLE001 — advisory diagnostics only
+            schema = None
+        publish_event(
+            "service.shared_scan",
+            participants=len(participants),
+            fingerprint=participants[0].fingerprint,
+        )
+        for i, sub in enumerate(participants):
+            handle = sub.handle
+            if sub.tenant in overdrawn:
+                self._on_cancelled(
+                    sub,
+                    RunCancelled(
+                        "quota",
+                        where="shared scan fan-out",
+                        progress={"participants": len(participants)},
+                    ),
+                )
+                continue
+            try:
+                metrics = {
+                    a: context.metric_map[a]
+                    for a in plans[i]
+                    if a in context.metric_map
+                }
+                result = VerificationSuite.evaluate(
+                    sub.checks, AnalyzerContext(metrics)
+                )
+                if schema is not None:
+                    try:
+                        from ..lint.planlint import validate_plan
+
+                        report = validate_plan(
+                            schema,
+                            sub.checks,
+                            sub.analyzers,
+                            mode="lenient",
+                            num_rows=int(table.num_rows),
+                            sharing_with=union,
+                        )
+                        result.validation_warnings = list(report.diagnostics)
+                        result.plan_cost = report.plan_cost
+                    except Exception:  # fault-ok: lint diagnostics are
+                        # advisory; the verified result stands without them
+                        pass
+                if captures is not None:
+                    result.forensics_report = captures[i].finalize(
+                        result.check_results
+                    )
+                handle.sharing = {
+                    "shared": True,
+                    "participants": len(participants),
+                    "proof": proofs[i].to_dict(),
+                    "drift": proofs[i].pin(executed),
+                }
+                self.breakers.record_success(sub.tenant, sub.dataset)
+                self.telemetry.count("completed")
+                handle.result = result
+                with self._cv:
+                    self._decrement_pending_locked(sub)
+                    self._finalize_locked_handle(handle, "done", None, "")
+            except Exception as exc:  # noqa: BLE001 — one tenant's
+                # evaluation failing must not poison its co-tenants
+                self.breakers.record_failure(sub.tenant, sub.dataset)
+                self.telemetry.count("failed")
+                handle.error = exc
+                with self._cv:
+                    self._decrement_pending_locked(sub)
+                    self._finalize_locked_handle(
+                        handle, "failed", None,
+                        f"{type(exc).__name__}: {exc}",
+                    )
+
+    @staticmethod
+    def _union_plan(plans: List[List[Any]]) -> Tuple[List[Any], List[List[int]]]:
+        from ..ops.fused import build_union_plan
+
+        return build_union_plan(plans)
+
+    def _shared_boundary_probe(
+        self, subs: List[_Submission], overdrawn: set
+    ) -> Callable[[Dict[str, Any]], Optional[str]]:
+        """Pro-rata quota enforcement for one shared scan: each newly
+        committed partition's bytes (the UNION read, approximated by
+        the widest participant's prediction) split across participants
+        proportional to their own solo demand. An overdrawn tenant is
+        marked and dropped at fan-out (DQ406) while the scan continues
+        for the others; the scan itself stops only when every
+        participant is overdrawn."""
+        from .sharing import prorata_weights
+
+        predicted = []
+        for s in subs:
+            p = 0.0
+            if s.cost is not None and s.cost.predicted_scan_bytes is not None:
+                p = float(s.cost.predicted_scan_bytes)
+            predicted.append(p)
+        _union_bytes, shares = prorata_weights(predicted)
+        charged = {"parts": 0}
+
+        def probe(progress: Dict[str, Any]) -> Optional[str]:
+            done = int(progress.get("partitions_done", 0))
+            scanned = done - int(progress.get("partitions_cached", 0))
+            total = int(progress.get("partitions_total", 0)) or 1
+            new = scanned - charged["parts"]
+            if new > 0:
+                charged["parts"] = scanned
+                for s, share in zip(subs, shares):
+                    if s.tenant in overdrawn:
+                        continue
+                    charge = new * share / total
+                    if charge > 0:
+                        self.ledger.charge_scan(s.tenant, charge)
+                        self.telemetry.charge_tenant_bytes(s.tenant, charge)
+            for s in subs:
+                if s.tenant in overdrawn:
+                    continue
+                over = self.ledger.over_scan_budget(s.tenant)
+                if not over:
+                    quota = self.ledger.quota(s.tenant)
+                    if quota.state_disk_bytes is not None:
+                        usage = self._state_disk_usage(s.tenant, s.dataset)
+                        over = (
+                            usage is not None
+                            and usage > quota.state_disk_bytes
+                        )
+                if over:
+                    overdrawn.add(s.tenant)
+            if all(s.tenant in overdrawn for s in subs):
+                return "quota"
+            return None
+
+        return probe
 
     def _on_cancelled(self, sub: _Submission, exc: RunCancelled) -> None:
         handle = sub.handle

@@ -1,0 +1,349 @@
+"""Fleet-level scan sharing: one superset scan per table, proven safe.
+
+The DQService isolates tenants but — before this module — scanned the
+same table once per tenant. The enabler for sharing is static: the
+plan-subsumption prover (lint/subsume.py) proves each participant's
+suite CONTAINED in the union plan the group synthesizes
+(ops/fused.build_union_plan), so ONE fused scan computes every
+participant's states and the fan-out is a pure selection over the
+semigroup — bit-identical to a solo run per tenant.
+
+What lives here (service/service.py orchestrates around it):
+
+* ``dataset_fingerprint`` — the grouping key. Content-based for
+  partitioned sources (the hash of the partition fingerprints the
+  state cache already keys on), object identity for a directly
+  submitted in-memory table. ``None`` means "cannot prove same data"
+  and the submission always scans solo.
+* ``plan_share_group`` — the prover gate: builds the union plan,
+  proves each candidate contained (environment components from the
+  live runtime knobs on BOTH sides, so a fold-variant or dtype flip
+  can never be silently merged), and splits participants from
+  declines with their DQ322-style fall-off reasons.
+* ``FanoutStateRepository`` — per-tenant state persistence for the
+  shared scan: every committed partition saves the union states under
+  the shared dataset AND each tenant's analyzer subset under the
+  tenant's own dataset with the tenant's own solo plan signature — so
+  a later solo run (or a re-formed group after preemption) resumes
+  from cache. Loads assemble the union from per-tenant entries when
+  the shared entry is missing, so a differently composed group still
+  resumes committed partitions.
+* ``ForensicsFanout`` — one ForensicsCapture per tenant behind the
+  fused pass's single forensics hook: reservoirs stay isolated per
+  tenant (and their RNG seeds are content-derived per constraint, so
+  each tenant's samples are bit-identical to its solo run).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..ops import runtime
+
+#: dataset name shared-scan state envelopes are keyed under
+SHARED_DATASET_PREFIX = "shared/"
+
+
+# -- grouping key -------------------------------------------------------------
+
+
+def dataset_fingerprint(data: Any, table: Any) -> Optional[str]:
+    """The sharing group key for one submission, or None when equality
+    of the underlying data cannot be established statically.
+
+    ``data`` is what the caller submitted (a Table or a factory),
+    ``table`` the opened Table. Partitioned sources fingerprint by
+    content — the digest of their partition fingerprints, stable
+    across re-opens of the same files. A directly submitted in-memory
+    table keys on object identity (two tenants passing the SAME Table
+    object provably verify the same data); a factory-opened in-memory
+    table has no stable identity across opens and never shares."""
+    parts_fn = getattr(table, "partitions", None)
+    if parts_fn is not None:
+        try:
+            h = hashlib.sha256()
+            n = 0
+            for part in parts_fn():
+                h.update(str(part.fingerprint).encode("utf-8") + b"\x00")
+                n += 1
+            if n:
+                return "parts:" + h.hexdigest()[:32]
+        except Exception:  # noqa: BLE001 — unknowable identity = no sharing
+            return None
+    if not callable(data) and data is table:
+        return f"obj:{id(table)}"
+    return None
+
+
+def shared_dataset_name(fingerprint: str) -> str:
+    return SHARED_DATASET_PREFIX + fingerprint.replace("/", "_")[:44]
+
+
+# -- the prover gate ----------------------------------------------------------
+
+
+def current_plan_env(table: Any, batch_size: Optional[int] = None):
+    """The live runtime's plan-signature components as a
+    `lint.subsume.PlanEnv` — the same fields
+    `repository.states.plan_signature_for` hashes."""
+    import numpy as np
+
+    from ..lint.subsume import PlanEnv
+
+    batch_rows = getattr(table, "batch_rows", None)
+    return PlanEnv(
+        placement=runtime.placement_mode(),
+        compute_dtype=np.dtype(runtime.compute_dtype()).name,
+        batch_size=batch_size,
+        batch_rows=int(batch_rows) if batch_rows else None,
+        fold_variant=runtime.fold_variant(),
+    )
+
+
+def submission_plan(checks: Sequence[Any], analyzers: Sequence[Any]) -> List[Any]:
+    """One submission's deduplicated analyzer plan — the same
+    collection order the verification suite uses (required analyzers
+    first, then each check's)."""
+    from ..lint.explain import _plan_analyzers
+
+    return _plan_analyzers(analyzers, checks)
+
+
+def plan_share_group(
+    plans: Sequence[List[Any]],
+    table: Any,
+) -> Tuple[List[Any], List[Any], List[Optional[str]]]:
+    """Prove a group of submission plans shareable over ``table``.
+
+    Returns ``(union, proofs, declines)``: the superset analyzer list,
+    one `SubsumptionProof` per plan, and per-plan decline reasons
+    (None = proven CONTAINED and safe to share). A plan declines when
+    its proof is anything but exact CONTAINED — the union is built by
+    engine-identity dedup, so equivalent-but-respelled wheres stay
+    separate members and every participant should prove exact; any
+    residual or mismatch here is a real incompatibility."""
+    from ..lint.schema import SchemaInfo
+    from ..lint.subsume import CONTAINED, prove_subsumption
+    from ..ops.fused import build_union_plan
+
+    union, _memberships = build_union_plan(plans)
+    try:
+        schema = SchemaInfo.from_table(table)
+    except Exception:  # noqa: BLE001 — prover degrades to structural
+        schema = None
+    env = current_plan_env(table)
+    proofs: List[Any] = []
+    declines: List[Optional[str]] = []
+    for plan in plans:
+        proof = prove_subsumption(
+            plan, union, schema, suite_env=env, scan_env=env
+        )
+        proofs.append(proof)
+        if proof.verdict == CONTAINED:
+            declines.append(None)
+        else:
+            declines.append(proof.summary())
+    return union, proofs, declines
+
+
+# -- per-tenant state fan-out -------------------------------------------------
+
+
+class TenantStatePlan:
+    """One tenant's slice of the shared scan's state persistence: the
+    dataset its envelopes are keyed under, the scan-shareable analyzer
+    subset a SOLO run of this tenant would fold, and that solo run's
+    plan signature."""
+
+    def __init__(self, dataset: str, analyzers: Sequence[Any], table: Any) -> None:
+        from ..repository.states import plan_signature_for
+
+        self.dataset = dataset
+        self.analyzers = scan_shareable_subset(analyzers, table)
+        self.signature = plan_signature_for(self.analyzers, table)
+
+
+def scan_shareable_subset(analyzers: Sequence[Any], table: Any) -> List[Any]:
+    """The sublist of ``analyzers`` a solo run's FusedScanPass would
+    fold — mirrors the runner's own filtering (dedupe, precondition
+    check, grouping split, scan-shareable only), so the signature
+    computed over it matches the solo run's exactly."""
+    from ..analyzers.base import Preconditions, ScanShareableAnalyzer
+    from ..analyzers.grouping import GroupingAnalyzer
+
+    seen: set = set()
+    subset: List[Any] = []
+    for a in analyzers:
+        if a in seen:
+            continue
+        seen.add(a)
+        if not isinstance(a, ScanShareableAnalyzer) or isinstance(
+            a, GroupingAnalyzer
+        ):
+            continue
+        try:
+            if Preconditions.find_first_failing(table, a.preconditions()):
+                continue
+        except Exception:  # noqa: BLE001 — failing precondition = no fold
+            continue
+        subset.append(a)
+    return subset
+
+
+class FanoutStateRepository:
+    """StateRepository facade for one shared scan.
+
+    The fused pass talks to it exactly like any repository — keyed by
+    the SHARED dataset and the union plan's signature. Saves
+    additionally fan each tenant's analyzer subset out under the
+    tenant's own (dataset, solo signature), so the shared scan warms
+    every participant's solo cache; loads fall back to assembling the
+    union from per-tenant entries, so a re-formed group (different
+    participants after a preemption) still resumes every partition any
+    earlier attempt committed."""
+
+    def __init__(self, inner: Any, tenants: Sequence[TenantStatePlan]) -> None:
+        self.inner = inner
+        self.tenants = list(tenants)
+
+    # -- cache surface (duck-typed StateRepository) --------------------------
+
+    def has_states(self, dataset: str, fingerprint: str, signature: str) -> bool:
+        if self.inner.has_states(dataset, fingerprint, signature):
+            return True
+        return bool(self.tenants) and all(
+            self.inner.has_states(t.dataset, fingerprint, t.signature)
+            for t in self.tenants
+        )
+
+    def load_states(
+        self,
+        dataset: str,
+        fingerprint: str,
+        signature: str,
+        analyzers: Sequence[Any],
+    ) -> Optional[List[Any]]:
+        states = self.inner.load_states(dataset, fingerprint, signature, analyzers)
+        if states is not None:
+            return states
+        # assemble the union from per-tenant solo entries
+        by_analyzer: Dict[Any, Any] = {}
+        for t in self.tenants:
+            if not t.analyzers:
+                continue
+            loaded = self.inner.load_states(
+                t.dataset, fingerprint, t.signature, t.analyzers
+            )
+            if loaded is None:
+                continue
+            for a, s in zip(t.analyzers, loaded):
+                by_analyzer.setdefault(a, s)
+        if not by_analyzer:
+            return None
+        if any(a not in by_analyzer for a in analyzers):
+            return None
+        return [by_analyzer[a] for a in analyzers]
+
+    def save_states(
+        self,
+        dataset: str,
+        fingerprint: str,
+        signature: str,
+        pairs: Sequence[Tuple[Any, Any]],
+    ) -> bool:
+        saved = self.inner.save_states(dataset, fingerprint, signature, pairs)
+        states = {a: s for a, s in pairs}
+        for t in self.tenants:
+            if not t.analyzers:
+                continue
+            if any(a not in states for a in t.analyzers):
+                continue  # best-effort: never a partial tenant envelope
+            self.inner.save_states(
+                t.dataset,
+                fingerprint,
+                t.signature,
+                [(a, states[a]) for a in t.analyzers],
+            )
+        return saved
+
+    def disk_usage(self, dataset: str) -> Optional[int]:
+        return self.inner.disk_usage(dataset)
+
+
+# -- per-tenant forensics fan-out ---------------------------------------------
+
+
+class ForensicsFanout:
+    """One ForensicsCapture per participant behind the single forensics
+    hook the fused pass drives. Every hook fans out; reservoirs and
+    coordinate state stay per-tenant, and because reservoir seeds are
+    content-derived per constraint (observe/forensics._batch_seed),
+    each tenant's samples are bit-identical to its solo run."""
+
+    def __init__(self, captures: Sequence[Any]) -> None:
+        self.captures = list(captures)
+
+    def note_plan_signature(self, signature: str) -> None:
+        for c in self.captures:
+            c.note_plan_signature(signature)
+
+    def note_partition(self, name: str, fingerprint: str, mode: str) -> None:
+        for c in self.captures:
+            c.note_partition(name, fingerprint, mode)
+
+    def enter_partition(self, name: str, fingerprint: str) -> "ForensicsFanout":
+        for c in self.captures:
+            c.enter_partition(name, fingerprint)
+        return self
+
+    def note_table(self, source: Any) -> None:
+        for c in self.captures:
+            c.note_table(source)
+
+    def note_decode_plan(self, plan: Any) -> None:
+        for c in self.captures:
+            c.note_decode_plan(plan)
+
+    def capture_batch(self, batch: Any, row_offset: int) -> None:
+        for c in self.captures:
+            c.capture_batch(batch, row_offset)
+
+
+# -- pro-rata quota split -----------------------------------------------------
+
+
+def prorata_weights(predicted: Sequence[float]) -> Tuple[float, List[float]]:
+    """Split one shared scan's bytes across participants.
+
+    ``predicted`` is each participant's own solo predicted scan bytes
+    (its EXPLAIN cost). The shared scan reads the union of columns
+    once — approximated by the WIDEST participant's prediction — and
+    each participant is charged its pro-rata share of that single
+    read, proportional to its own demand (even split when no
+    prediction is available). Returns ``(union_bytes, shares)`` with
+    ``sum(shares) == union_bytes``: together the tenants pay for one
+    scan, not K."""
+    n = len(predicted)
+    if n == 0:
+        return 0.0, []
+    union_bytes = max(float(p) for p in predicted)
+    total = sum(float(p) for p in predicted)
+    if union_bytes <= 0.0 or total <= 0.0:
+        return 0.0, [0.0] * n
+    return union_bytes, [union_bytes * float(p) / total for p in predicted]
+
+
+__all__ = [
+    "FanoutStateRepository",
+    "ForensicsFanout",
+    "SHARED_DATASET_PREFIX",
+    "TenantStatePlan",
+    "current_plan_env",
+    "dataset_fingerprint",
+    "plan_share_group",
+    "prorata_weights",
+    "scan_shareable_subset",
+    "shared_dataset_name",
+    "submission_plan",
+]

@@ -31,6 +31,10 @@ COUNTERS = (
     "queue_faults",
     "worker_faults",
     "admission_faults",
+    # fleet-wide scan sharing (service/sharing.py)
+    "shared_scans",
+    "shared_participants",
+    "sharing_declined",
 )
 
 

@@ -419,6 +419,82 @@ def test_pushdown_rule_covers_the_interpreter_file():
     assert f"deequ_tpu{sep}lint{sep}pushdown.py" in lint.PUSHDOWN_FILES
 
 
+# -- SUBSUME: purity of the plan-subsumption prover (ISSUE 17 satellite) -----
+
+
+def test_subsume_checker_flags_jax_import_even_lazy():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def fold(xs):\n"
+        "    import jax.numpy as jnp\n"
+        "    return jnp.sum(jnp.asarray(xs))\n"
+    )
+    try:
+        findings = lint.check_subsume_purity(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+    assert "SUBSUME" in findings[0] and "jax" in findings[0]
+
+
+def test_subsume_checker_flags_service_and_relative_runtime_imports():
+    lint = _lint_module()
+    path = _tmp_source(
+        "from deequ_tpu.service.sharing import plan_share_group\n"
+        "def peek():\n"
+        "    from ..ops import runtime\n"
+        "    return runtime\n"
+    )
+    try:
+        findings = lint.check_subsume_purity(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 2
+    assert all("SUBSUME" in f for f in findings)
+    assert any("deequ_tpu.service" in f for f in findings)
+    assert any("deequ_tpu.ops" in f for f in findings)
+
+
+def test_subsume_checker_flags_open_call():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def sniff(path):\n"
+        "    with open(path) as f:\n"
+        "        return f.read()\n"
+    )
+    try:
+        findings = lint.check_subsume_purity(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+    assert "SUBSUME" in findings[0] and "open" in findings[0]
+
+
+def test_subsume_checker_allows_the_pure_prover_imports():
+    lint = _lint_module()
+    path = _tmp_source(
+        "from deequ_tpu.data.expr import parse\n"
+        "from deequ_tpu.lint.fold import satisfiability\n"
+        "from deequ_tpu.lint.schema import SchemaInfo\n"
+        "def implies(a, b, schema):\n"
+        "    return satisfiability(parse(a), schema)\n"
+    )
+    try:
+        findings = lint.check_subsume_purity(path)
+    finally:
+        os.unlink(path)
+    assert findings == []
+
+
+def test_subsume_rule_covers_the_prover_file_and_it_is_clean():
+    lint = _lint_module()
+    sep = os.sep
+    rel = f"deequ_tpu{sep}lint{sep}subsume.py"
+    assert rel in lint.SUBSUME_FILES
+    path = os.path.join(lint.REPO, rel)
+    assert lint.check_subsume_purity(path) == []
+
+
 def test_globalmut_reads_are_not_findings():
     lint = _lint_module()
     path = _tmp_source(

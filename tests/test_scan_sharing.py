@@ -1,0 +1,481 @@
+"""Fleet-wide scan sharing (service/sharing.py + the DQService group
+scheduler): one proven superset scan per table, fanned back out to
+every participating tenant BIT-identically to their solo runs
+(ISSUE 17).
+
+The load-bearing invariants:
+
+* fan-out exactness — every participant's metrics, check statuses, and
+  forensics samples equal its solo run's, because the union scan folds
+  the identical per-analyzer states over the same semigroup;
+* proofs pinned — each participant carries a CONTAINED subsumption
+  proof whose post-execution drift counters are all zero;
+* isolation — pro-rata quota charges (one scan's bytes split across
+  the group, never K scans'), per-tenant forensics reservoirs, and
+  per-tenant state-cache entries the shared scan warms;
+* consistency under scheduling — preemption/cancellation of a shared
+  scan re-queues or finalizes EVERY participant, never a partial
+  fan-out; the prover declining a member falls it back to a solo run.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from deequ_tpu import Check, CheckLevel, VerificationSuite
+from deequ_tpu.core.controller import DQ_QUOTA
+from deequ_tpu.data.table import Table
+from deequ_tpu.repository.states import FileSystemStateRepository
+from deequ_tpu.service import DQService, TenantQuota
+from deequ_tpu.service import sharing
+
+from test_suite_differential_fuzz import (
+    _write_partition,
+    random_table,
+    suite_snapshot,
+)
+
+
+# ---------------------------------------------------------------------------
+# fixtures & helpers
+# ---------------------------------------------------------------------------
+
+
+def _make_dataset(tmp_path, seed=7, parts=3):
+    data_dir = tmp_path / "ds"
+    data_dir.mkdir()
+    rng = np.random.default_rng(seed)
+    for i in range(parts):
+        _write_partition(random_table(rng), str(data_dir / f"p{i}.parquet"))
+    return data_dir
+
+
+def _factory(data_dir):
+    return lambda: Table.scan_parquet_dataset(str(data_dir))
+
+
+def _tenant_checks():
+    return {
+        "t1": Check(CheckLevel.ERROR, "c1")
+        .is_complete("x")
+        .has_mean("x", lambda m: True),
+        "t2": Check(CheckLevel.ERROR, "c2")
+        .is_complete("s")
+        .has_mean("x", lambda m: True),
+        "t3": Check(CheckLevel.ERROR, "c3")
+        .has_size(lambda v: v > 0)
+        .has_standard_deviation("x", lambda s: True),
+    }
+
+
+def _solo_snapshots(factory, checks):
+    out = {}
+    for tenant, check in checks.items():
+        result = (
+            VerificationSuite()
+            .on_data(factory())
+            .add_check(check)
+            .with_engine("single")
+            .run()
+        )
+        out[tenant] = suite_snapshot(result)
+    return out
+
+
+def _blocker():
+    """A submission over a DIFFERENT (in-memory, unshareable) dataset
+    whose slow assertion occupies the single worker long enough for
+    the real group to queue up behind it."""
+    table = Table.from_pydict({"k": ["a", "b", "c"]})
+    check = Check(CheckLevel.ERROR, "blocker").has_size(
+        lambda v: (time.sleep(0.8) or v >= 0)
+    )
+    return (lambda: table), check
+
+
+def _submit_group(svc, factory, checks):
+    bdata, bcheck = _blocker()
+    blocker = svc.submit("blocker", "other", bdata, checks=[bcheck])
+    time.sleep(0.25)
+    handles = {
+        tenant: svc.submit(tenant, "ds", factory, checks=[check])
+        for tenant, check in checks.items()
+    }
+    return blocker, handles
+
+
+def _await_done(handles, timeout=60):
+    for tenant, handle in handles.items():
+        assert handle.wait(timeout), (tenant, handle.status)
+
+
+# ---------------------------------------------------------------------------
+# fan-out exactness + pinned proofs
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("placement", ["host", "device"])
+def test_shared_scan_bit_identical_to_solo_with_pinned_proofs(
+    placement, monkeypatch, tmp_path
+):
+    monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+    data_dir = _make_dataset(tmp_path)
+    factory = _factory(data_dir)
+    checks = _tenant_checks()
+    solo = _solo_snapshots(factory, checks)
+
+    with DQService(workers=1) as svc:
+        blocker, handles = _submit_group(svc, factory, checks)
+        _await_done({**handles, "blocker": blocker})
+        assert svc.telemetry.value("shared_scans") >= 1
+        shared = [t for t, h in handles.items() if h.sharing and h.sharing["shared"]]
+        assert len(shared) >= 2, "group never formed"
+        for tenant, handle in handles.items():
+            assert handle.status == "done", (tenant, handle.reason, handle.error)
+            assert suite_snapshot(handle.result) == solo[tenant], tenant
+        for tenant in shared:
+            info = handles[tenant].sharing
+            assert info["proof"]["verdict"] == "CONTAINED"
+            assert info["participants"] == len(shared)
+            assert all(v == 0 for v in info["drift"].values()), (tenant, info)
+
+
+def test_kill_switch_disables_grouping_but_not_results(monkeypatch, tmp_path):
+    monkeypatch.setenv("DEEQU_TPU_SCAN_SHARING", "0")
+    data_dir = _make_dataset(tmp_path)
+    factory = _factory(data_dir)
+    checks = _tenant_checks()
+    solo = _solo_snapshots(factory, checks)
+
+    with DQService(workers=1) as svc:
+        blocker, handles = _submit_group(svc, factory, checks)
+        _await_done({**handles, "blocker": blocker})
+        assert svc.telemetry.value("shared_scans") == 0
+        for tenant, handle in handles.items():
+            assert handle.status == "done"
+            assert handle.sharing is None
+            assert suite_snapshot(handle.result) == solo[tenant], tenant
+
+
+def test_share_group_max_caps_participation(monkeypatch, tmp_path):
+    monkeypatch.setenv("DEEQU_TPU_SHARE_GROUP_MAX", "2")
+    data_dir = _make_dataset(tmp_path)
+    factory = _factory(data_dir)
+    checks = _tenant_checks()
+    solo = _solo_snapshots(factory, checks)
+
+    with DQService(workers=1) as svc:
+        blocker, handles = _submit_group(svc, factory, checks)
+        _await_done({**handles, "blocker": blocker})
+        for tenant, handle in handles.items():
+            assert handle.status == "done"
+            assert suite_snapshot(handle.result) == solo[tenant], tenant
+            if handle.sharing and handle.sharing["shared"]:
+                assert handle.sharing["participants"] <= 2
+
+
+# ---------------------------------------------------------------------------
+# pro-rata quota accounting
+# ---------------------------------------------------------------------------
+
+
+def test_prorata_weights_sum_to_one_scan():
+    union, shares = sharing.prorata_weights([300.0, 100.0, 100.0])
+    assert union == 300.0
+    assert shares == pytest.approx([180.0, 60.0, 60.0])
+    assert sum(shares) == pytest.approx(union)
+    assert sharing.prorata_weights([]) == (0.0, [])
+    assert sharing.prorata_weights([0.0, 0.0]) == (0.0, [0.0, 0.0])
+
+
+def test_shared_scan_charges_one_scan_pro_rata(monkeypatch, tmp_path):
+    data_dir = _make_dataset(tmp_path)
+    factory = _factory(data_dir)
+    checks = _tenant_checks()
+
+    # empirical solo baseline: what each tenant pays when it scans alone
+    solo_charge = {}
+    for tenant, check in checks.items():
+        with DQService(workers=1) as ref:
+            handle = ref.submit(tenant, "ds", factory, checks=[check])
+            assert handle.wait(60) and handle.status == "done"
+            solo_charge[tenant] = ref.ledger.bytes_total(tenant)
+    assert all(b > 0 for b in solo_charge.values()), solo_charge
+
+    with DQService(workers=1) as svc:
+        blocker, handles = _submit_group(svc, factory, checks)
+        _await_done({**handles, "blocker": blocker})
+        shared = [t for t, h in handles.items() if h.sharing and h.sharing["shared"]]
+        assert len(shared) >= 2
+        per_tenant = {t: svc.ledger.bytes_total(t) for t in shared}
+        assert all(b > 0 for b in per_tenant.values()), per_tenant
+        # together the group paid for ONE union scan — the WIDEST
+        # participant's solo bill, split pro-rata — not K scans
+        total = sum(per_tenant.values())
+        solo_shared = [solo_charge[t] for t in shared]
+        assert total == pytest.approx(max(solo_shared), rel=0.05), (
+            per_tenant,
+            solo_charge,
+        )
+        assert total < 0.8 * sum(solo_shared)
+        # and no participant pays more shared than it would have alone
+        for tenant in shared:
+            assert per_tenant[tenant] <= solo_charge[tenant] * 1.05, tenant
+
+
+def test_overdrawn_tenant_dropped_at_fanout_scan_continues(monkeypatch, tmp_path):
+    data_dir = _make_dataset(tmp_path)
+    factory = _factory(data_dir)
+    checks = _tenant_checks()
+    solo = _solo_snapshots(factory, checks)
+    window = 50 * 1024 * 1024
+
+    quotas = {"t1": TenantQuota(scan_bytes_per_window=float(window), window_s=3600.0)}
+    with DQService(workers=1, quotas=quotas) as svc:
+        # t1's window is already blown before its run starts; admission
+        # still admits (the plan itself fits the window) but the shared
+        # scan's boundary probe marks it overdrawn and drops it at
+        # fan-out — while its co-tenants' scan completes untouched
+        svc.ledger.charge_scan("t1", float(window) + 1.0)
+        blocker, handles = _submit_group(svc, factory, checks)
+        _await_done({**handles, "blocker": blocker})
+        assert handles["t1"].status == "quota", handles["t1"].reason
+        assert handles["t1"].code == DQ_QUOTA
+        for tenant in ("t2", "t3"):
+            assert handles[tenant].status == "done", handles[tenant].reason
+            assert suite_snapshot(handles[tenant].result) == solo[tenant]
+
+
+# ---------------------------------------------------------------------------
+# consistency under preemption: never a partial fan-out
+# ---------------------------------------------------------------------------
+
+
+def test_preempted_shared_scan_requeues_every_participant(monkeypatch, tmp_path):
+    data_dir = _make_dataset(tmp_path, parts=4)
+    factory = _factory(data_dir)
+    checks = _tenant_checks()
+    solo = _solo_snapshots(factory, checks)
+
+    fired = {"n": 0}
+    real_probe = DQService._shared_boundary_probe
+
+    def preempting_probe(self, subs, overdrawn):
+        inner = real_probe(self, subs, overdrawn)
+
+        def probe(progress):
+            if fired["n"] == 0 and int(progress.get("partitions_done", 0)) >= 1:
+                fired["n"] += 1
+                return "preempted"
+            return inner(progress)
+
+        return probe
+
+    monkeypatch.setattr(DQService, "_shared_boundary_probe", preempting_probe)
+
+    repo = FileSystemStateRepository(str(tmp_path / "cache"))
+    with DQService(workers=1, state_repository=repo) as svc:
+        blocker, handles = _submit_group(svc, factory, checks)
+        _await_done({**handles, "blocker": blocker})
+        assert fired["n"] == 1, "shared scan was never preempted"
+        shared = [t for t, h in handles.items() if h.sharing and h.sharing["shared"]]
+        assert len(shared) >= 2
+        # EVERY participant was re-queued (attempts > 1) and completed
+        # bit-identically — committed partition states made the retry
+        # incremental, never a partial fan-out
+        for tenant, handle in handles.items():
+            assert handle.status == "done", (tenant, handle.reason)
+            assert handle.preemptions == 1, tenant
+            assert handle.attempts >= 2, tenant
+            assert suite_snapshot(handle.result) == solo[tenant], tenant
+
+
+def test_declined_member_falls_back_to_solo_run(monkeypatch, tmp_path):
+    data_dir = _make_dataset(tmp_path)
+    factory = _factory(data_dir)
+    checks = _tenant_checks()
+    solo = _solo_snapshots(factory, checks)
+
+    real = sharing.plan_share_group
+
+    def declining(plans, table):
+        union, proofs, declines = real(plans, table)
+        if len(plans) > 1:
+            declines = list(declines)
+            declines[-1] = "forced decline (test)"
+        return union, proofs, declines
+
+    monkeypatch.setattr(sharing, "plan_share_group", declining)
+
+    with DQService(workers=1) as svc:
+        blocker, handles = _submit_group(svc, factory, checks)
+        _await_done({**handles, "blocker": blocker})
+        assert svc.telemetry.value("sharing_declined") >= 1
+        declined = [
+            t
+            for t, h in handles.items()
+            if h.sharing and not h.sharing["shared"]
+        ]
+        assert declined, "no member was declined"
+        for tenant, handle in handles.items():
+            assert handle.status == "done", (tenant, handle.reason)
+            assert suite_snapshot(handle.result) == solo[tenant], tenant
+        for tenant in declined:
+            assert handles[tenant].sharing["reason"] == "forced decline (test)"
+
+
+# ---------------------------------------------------------------------------
+# per-tenant state fan-out: the shared scan warms every solo cache
+# ---------------------------------------------------------------------------
+
+
+def test_shared_scan_warms_each_tenants_solo_cache(monkeypatch, tmp_path):
+    monkeypatch.setenv("DEEQU_TPU_STATE_CACHE", "1")
+    data_dir = _make_dataset(tmp_path)
+    factory = _factory(data_dir)
+    checks = _tenant_checks()
+    solo = _solo_snapshots(factory, checks)
+    repo = FileSystemStateRepository(str(tmp_path / "cache"))
+
+    with DQService(workers=1, state_repository=repo) as svc:
+        blocker, handles = _submit_group(svc, factory, checks)
+        _await_done({**handles, "blocker": blocker})
+        shared = [t for t, h in handles.items() if h.sharing and h.sharing["shared"]]
+        assert len(shared) >= 2
+
+    table = factory()
+    fingerprints = [p.fingerprint for p in table.partitions()]
+    for tenant in shared:
+        plan = sharing.submission_plan([checks[tenant]], [])
+        tsp = sharing.TenantStatePlan(f"{tenant}/ds", plan, table)
+        assert tsp.analyzers, tenant
+        for fp in fingerprints:
+            assert repo.has_states(f"{tenant}/ds", fp, tsp.signature), (tenant, fp)
+        # an all-warm solo run off the fanned-out entries stays exact
+        result = (
+            VerificationSuite()
+            .on_data(factory())
+            .add_check(checks[tenant])
+            .with_engine("single")
+            .with_state_repository(repo, f"{tenant}/ds")
+            .run()
+        )
+        assert suite_snapshot(result) == solo[tenant], tenant
+
+
+def test_fanout_repository_assembles_union_from_tenant_entries(tmp_path):
+    """Unit: loads fall back to per-tenant solo entries, so a re-formed
+    group resumes partitions an earlier (different) group committed."""
+
+    class DictRepo:
+        def __init__(self):
+            self.store = {}
+
+        def has_states(self, dataset, fingerprint, signature):
+            return (dataset, fingerprint, signature) in self.store
+
+        def load_states(self, dataset, fingerprint, signature, analyzers):
+            entry = self.store.get((dataset, fingerprint, signature))
+            if entry is None:
+                return None
+            try:
+                return [entry[a] for a in analyzers]
+            except KeyError:
+                return None
+
+        def save_states(self, dataset, fingerprint, signature, pairs):
+            self.store[(dataset, fingerprint, signature)] = dict(pairs)
+            return True
+
+        def disk_usage(self, dataset):
+            return 0
+
+    from deequ_tpu.analyzers import Completeness, Mean
+
+    table = Table.from_pydict({"x": [1.0, 2.0], "s": ["a", None]})
+    a1, a2 = Completeness("x"), Mean("x")
+    t1 = sharing.TenantStatePlan("t1/ds", [a1], table)
+    t2 = sharing.TenantStatePlan("t2/ds", [a1, a2], table)
+    inner = DictRepo()
+    fan = sharing.FanoutStateRepository(inner, [t1, t2])
+
+    saved = fan.save_states("shared/x", "fp0", "sig-union", [(a1, "s1"), (a2, "s2")])
+    assert saved
+    # every tenant's solo entry exists under its own dataset + signature
+    assert inner.has_states("t1/ds", "fp0", t1.signature)
+    assert inner.has_states("t2/ds", "fp0", t2.signature)
+    assert inner.load_states("t1/ds", "fp0", t1.signature, [a1]) == ["s1"]
+
+    # drop the shared entry: the union still assembles from the tenants
+    del inner.store[("shared/x", "fp0", "sig-union")]
+    assert fan.has_states("shared/x", "fp0", "sig-union")
+    assert fan.load_states("shared/x", "fp0", "sig-union", [a1, a2]) == ["s1", "s2"]
+    # a union member no tenant persisted is a miss, not a partial load
+    a3 = Completeness("s")
+    assert fan.load_states("shared/x", "fp0", "sig-union", [a1, a3]) is None
+
+
+# ---------------------------------------------------------------------------
+# per-tenant forensics isolation
+# ---------------------------------------------------------------------------
+
+
+def test_forensics_reservoirs_isolated_and_identical_to_solo(monkeypatch, tmp_path):
+    monkeypatch.setenv("DEEQU_TPU_FORENSICS", "1")
+    data_dir = _make_dataset(tmp_path)
+    factory = _factory(data_dir)
+    checks = {
+        "t1": Check(CheckLevel.ERROR, "f1").is_complete("x"),
+        "t2": Check(CheckLevel.ERROR, "f2").is_complete("s").is_complete("x"),
+    }
+
+    def solo_forensics(tenant):
+        result = (
+            VerificationSuite()
+            .on_data(factory())
+            .add_check(checks[tenant])
+            .with_engine("single")
+            .run()
+        )
+        assert result.forensics_report is not None
+        return [c.to_dict() for c in result.forensics_report.constraints]
+
+    solo = {t: solo_forensics(t) for t in checks}
+    solo_snap = _solo_snapshots(factory, checks)
+
+    with DQService(workers=1) as svc:
+        blocker, handles = _submit_group(svc, factory, checks)
+        _await_done({**handles, "blocker": blocker})
+        shared = [t for t, h in handles.items() if h.sharing and h.sharing["shared"]]
+        assert sorted(shared) == ["t1", "t2"]
+        for tenant, handle in handles.items():
+            assert suite_snapshot(handle.result) == solo_snap[tenant]
+            report = handle.result.forensics_report
+            assert report is not None, tenant
+            # reservoirs are seeded from violating-row content, so each
+            # tenant's shared-scan samples are BIT-identical to solo —
+            # and contain only that tenant's own constraints
+            assert [c.to_dict() for c in report.constraints] == solo[tenant], tenant
+
+
+# ---------------------------------------------------------------------------
+# grouping key
+# ---------------------------------------------------------------------------
+
+
+def test_dataset_fingerprint_rules(tmp_path):
+    data_dir = _make_dataset(tmp_path)
+    t1 = Table.scan_parquet_dataset(str(data_dir))
+    t2 = Table.scan_parquet_dataset(str(data_dir))
+    f1 = sharing.dataset_fingerprint(lambda: t1, t1)
+    f2 = sharing.dataset_fingerprint(lambda: t2, t2)
+    assert f1 is not None and f1 == f2, "content identity must survive re-opens"
+
+    mem = Table.from_pydict({"x": [1.0]})
+    direct = sharing.dataset_fingerprint(mem, mem)
+    assert direct == f"obj:{id(mem)}"
+    # a factory-opened in-memory table has no stable identity
+    assert sharing.dataset_fingerprint(lambda: mem, mem) is None

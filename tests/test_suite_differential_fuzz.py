@@ -1197,3 +1197,81 @@ def test_sigkill_resume_scans_only_remaining_partitions(tmp_path):
         assert struct.pack(">d", clean.metric_map[a].value.get()) == struct.pack(
             ">d", resumed.metric_map[a].value.get()
         ), repr(a)
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide scan sharing: shared vs solo (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_scan_sharing_shared_vs_solo_bit_identical(seed, monkeypatch, tmp_path):
+    """N randomly-overlapping suites submitted to the DQService over
+    one table must land BIT-identical to each suite's solo run — exact
+    snapshot equality, sketches included — whether the scheduler put
+    them on a shared superset scan or not, on BOTH placements. Every
+    shared participant must carry a CONTAINED subsumption proof pinned
+    with zero drift."""
+    import time as _time
+
+    from deequ_tpu.data.table import Table as TableCls
+    from deequ_tpu.service import DQService
+
+    rng = np.random.default_rng(41_000 + seed)
+    data_dir = tmp_path / "dataset"
+    data_dir.mkdir()
+    for i in range(3):
+        _write_partition(random_table(rng), str(data_dir / f"part-{i}.parquet"))
+
+    def factory():
+        return TableCls.scan_parquet_dataset(str(data_dir))
+
+    # overlapping suites: constraints drawn from one pool, so tenants
+    # randomly share analyzers (the union-dedup path) and randomly
+    # bring their own (the superset path)
+    n_tenants = int(rng.integers(2, 5))
+    checks = {
+        f"tenant{i}": random_check(rng) for i in range(n_tenants)
+    }
+
+    for placement in ("host", "device"):
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+        solo = {}
+        for tenant, check in checks.items():
+            builder = VerificationSuite().on_data(factory()).add_check(check)
+            solo[tenant] = suite_snapshot(builder.with_engine("single").run())
+
+        blocker_table = TableCls.from_pydict({"k": ["a"]})
+        blocker_check = Check(CheckLevel.ERROR, "blocker").has_size(
+            lambda v: (_time.sleep(0.8) or v >= 0)
+        )
+        with DQService(workers=1) as svc:
+            blocker = svc.submit(
+                "blocker", "other", lambda: blocker_table,
+                checks=[blocker_check],
+            )
+            _time.sleep(0.25)
+            handles = {
+                tenant: svc.submit(tenant, "ds", factory, checks=[check])
+                for tenant, check in checks.items()
+            }
+            assert blocker.wait(120)
+            for tenant, handle in handles.items():
+                assert handle.wait(120), (placement, tenant)
+                assert handle.status == "done", (
+                    placement, tenant, handle.reason, handle.error,
+                )
+                assert suite_snapshot(handle.result) == solo[tenant], (
+                    placement, tenant,
+                )
+                if handle.sharing is not None and handle.sharing["shared"]:
+                    assert handle.sharing["proof"]["verdict"] == "CONTAINED"
+                    assert all(
+                        v == 0 for v in handle.sharing["drift"].values()
+                    ), (placement, tenant, handle.sharing["drift"])
+            shared_n = sum(
+                1
+                for h in handles.values()
+                if h.sharing is not None and h.sharing["shared"]
+            )
+            assert shared_n >= 2, f"group never formed on {placement}"

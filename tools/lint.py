@@ -38,6 +38,15 @@ Checks:
              is the single reader. Purity keeps every verdict unit-
              testable without files and the lint layer importable
              without pyarrow.
+  SUBSUME  — deequ_tpu/lint/subsume.py (the plan-subsumption prover)
+             must stay import-pure like PUSHDOWN: no jax/numpy/
+             pyarrow/pandas import, no deequ_tpu.service/ops/runners/
+             repository/parallel/verification import (not even lazily),
+             and no `open(...)` call. The prover's verdicts gate which
+             tenants share one fleet-wide scan — they must be provable
+             from the plans alone, unit-testable without an
+             accelerator, and importable by tools that never touch the
+             runtime.
   DECODE   — the fast-path decode modules (data/arrow_decode.py,
              ops/native/) must stay buffer-level: no `.to_numpy(...)`
              and no `frombuffer(...)` copy idioms outside designated
@@ -140,6 +149,17 @@ OBSPRINT_DIRS = (os.path.join("deequ_tpu", "observe"),)
 # Pure-interpreter files: no pyarrow/pandas imports, no open() calls.
 PUSHDOWN_FILES = [os.path.join("deequ_tpu", "lint", "pushdown.py")]
 PUSHDOWN_FORBIDDEN_MODULES = {"pyarrow", "pandas"}
+
+SUBSUME_FILES = [os.path.join("deequ_tpu", "lint", "subsume.py")]
+SUBSUME_FORBIDDEN_MODULES = {"jax", "jaxlib", "numpy", "pyarrow", "pandas"}
+SUBSUME_FORBIDDEN_PREFIXES = (
+    "deequ_tpu.service",
+    "deequ_tpu.ops",
+    "deequ_tpu.runners",
+    "deequ_tpu.repository",
+    "deequ_tpu.parallel",
+    "deequ_tpu.verification",
+)
 # Fast-path decode modules: buffer-level only, no host-copy idioms
 # outside designated fallback functions (names ending `_fallback`).
 DECODE_FILES = [
@@ -379,6 +399,54 @@ def check_pushdown_purity(path: str) -> List[str]:
                 f"{_rel(path)}:{node.lineno}: PUSHDOWN `open(...)` in the "
                 f"stats interpreter — it must never touch files; pass "
                 f"RowGroupStats in"
+            )
+    return findings
+
+
+# -- SUBSUME: purity of the plan-subsumption prover ---------------------------
+
+
+def check_subsume_purity(path: str) -> List[str]:
+    """Flag accelerator/runtime imports (top-level or inside any
+    function) and `open(...)` calls in the subsumption prover: its
+    verdicts gate fleet-wide scan sharing and must be provable from
+    the plans alone — no jax, no table IO, no service machinery."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    findings: List[str] = []
+    for node in ast.walk(tree):
+        modules: List[str] = []
+        if isinstance(node, ast.Import):
+            modules = [alias.name for alias in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                # relative import: resolve against the prover's package
+                # (deequ_tpu.lint for level 1, deequ_tpu for level 2)
+                base = "deequ_tpu.lint" if node.level == 1 else "deequ_tpu"
+                modules = [f"{base}.{node.module}" if node.module else base]
+            elif node.module:
+                modules = [node.module]
+        for mod in modules:
+            bad = mod.split(".")[0] in SUBSUME_FORBIDDEN_MODULES or any(
+                mod == p or mod.startswith(p + ".")
+                for p in SUBSUME_FORBIDDEN_PREFIXES
+            )
+            if bad:
+                findings.append(
+                    f"{_rel(path)}:{node.lineno}: SUBSUME `{mod}` import "
+                    f"in the subsumption prover — containment verdicts "
+                    f"must be provable from the plans alone (expression "
+                    f"AST + lint lattice only)"
+                )
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "open"
+        ):
+            findings.append(
+                f"{_rel(path)}:{node.lineno}: SUBSUME `open(...)` in the "
+                f"subsumption prover — it must never touch files; plans "
+                f"and schemas arrive as arguments"
             )
     return findings
 
@@ -946,6 +1014,11 @@ def main() -> int:
         path = os.path.join(REPO, rel)
         if os.path.exists(path):
             findings.extend(check_pushdown_purity(path))
+
+    for rel in SUBSUME_FILES:
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):
+            findings.extend(check_subsume_purity(path))
 
     for rel in DECODE_FILES:
         path = os.path.join(REPO, rel)

@@ -73,6 +73,12 @@ WATCHED_SERIES: Sequence[Tuple[str, str]] = (
     # hitting (fingerprints churning, plan signature drifting, envelope
     # decode failures falling back to rescan)
     ("engine.state_cache_hit_ratio", "down"),
+    # compiled-plan cache effectiveness: the fraction of fused-fn
+    # lookups whose plan shape was already jitted (the fuse cost paid
+    # once per shape fleet-wide); a drop means plan shapes stopped
+    # deduplicating (shape key churning, cache evicting under max-size,
+    # tenants diverging in analyzer spelling)
+    ("engine.plan_cache_hit_ratio", "down"),
     # transient-fault recovery: the fraction of retried IO operations
     # that recovered within the retry budget; a drop means transient
     # faults stopped being absorbed (budget misconfigured, backoff too
