@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint typecheck analyze sentinel test test-fast trace-demo chaos service-chaos bench-pushdown bench-decode bench-wire bench-incremental bench-reader bench-forensics bench-chaos bench-service bench-mesh bench-sharing clean-native
+.PHONY: lint typecheck analyze sentinel test test-fast trace-demo chaos service-chaos bench-pushdown bench-decode bench-wire bench-incremental bench-reader bench-forensics bench-chaos bench-service bench-mesh bench-sharing bench-window clean-native
 
 lint:
 	$(PY) tools/lint.py
@@ -142,6 +142,17 @@ bench-mesh:
 BENCH_SHARING_ROWS ?= 8000000
 bench-sharing:
 	JAX_PLATFORMS=cpu BENCH_SHARING_ROWS=$(BENCH_SHARING_ROWS) $(PY) tools/bench_sharing.py
+
+# windowed state algebra A/B (ISSUE 18): a 30-partition daily dataset
+# is cold-filled, then a warm 7-day sliding window query plus a
+# week-over-week drift check — pure DQSG segment merges, zero data rows
+# — races cache-off full rescans of the same current+prior week
+# partitions. A traced proof pass pins partitions_scanned == 0 and
+# every cover span a segment hit; any metric mismatch ABORTS. Refreshes
+# BENCH_WINDOW.json (methodology: BENCH.md round 18)
+BENCH_WINDOW_ROWS ?= 6000000
+bench-window:
+	JAX_PLATFORMS=cpu BENCH_MODE=window BENCH_ROWS=$(BENCH_WINDOW_ROWS) $(PY) bench.py
 
 # remove cached native builds (the hash-named .so files): any strays in
 # the package tree from older versions plus the per-user cache dir the

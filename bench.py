@@ -46,6 +46,16 @@ Knobs (env):
                     aborts unless metrics are bit-identical and exactly
                     one partition scanned. BENCH_INCR_PARTS sets the
                     partition count (default 12, min 10)
+                    window = windowed state algebra A/B
+                    (BENCH_WINDOW.json, BENCH.md round 18): a
+                    30-partition daily dataset is cold-filled, then a
+                    warm 7-day sliding window query PLUS a week-over-week
+                    drift check (all segment merges, zero data rows)
+                    races cache-off full rescans of the same
+                    current+prior week partitions; a traced proof pass
+                    pins partitions_scanned == 0 and every cover span a
+                    segment hit, and any metric mismatch aborts.
+                    BENCH_WINDOW_PARTS sets the day count (default 30)
                     reader = native parquet page->wire reader A/B
                     (BENCH_READER.json, BENCH.md round 12): the decode
                     bench's 50-column wide-stream scan under a 50 ms
@@ -1534,6 +1544,229 @@ def run_incremental_bench(n_rows: int) -> None:
     print(json.dumps(rec))
 
 
+def write_window_dataset(n_rows: int, n_parts: int, dir_path: str) -> None:
+    """A daily-partitioned dataset (one parquet file per calendar day,
+    date-named so windows/spec.py derives the time axis from the
+    layout). Partition i is a pure function of i, like the incremental
+    dataset, so re-running never perturbs existing days."""
+    import datetime
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(dir_path, exist_ok=True)
+    per_part = max(1, n_rows // n_parts)
+    day0 = datetime.date(2026, 1, 1)
+    for i in range(n_parts):
+        day = day0 + datetime.timedelta(days=i)
+        path = os.path.join(dir_path, f"part-{day.isoformat()}.parquet")
+        if os.path.exists(path):
+            continue
+        rng = np.random.default_rng(2_000 + i)
+        x = rng.normal(50.0 + 0.1 * i, 10.0, per_part)
+        x[rng.random(per_part) < 0.05] = np.nan
+        table = pa.table(
+            {
+                "x": x,
+                "y": x * 0.5 + rng.normal(0.0, 1.0, per_part),
+                "g": rng.integers(0, 10_000, per_part),
+            }
+        )
+        pq.write_table(table, path, row_group_size=max(4096, per_part // 8))
+
+
+def run_window_bench(n_rows: int) -> None:
+    """BENCH_MODE=window: A/B the windowed state algebra (windows/) on a
+    30-partition daily dataset. Cold fill commits per-partition states;
+    an untimed first window query publishes the DQSG segment covers.
+    Then the warm B side — a 7-day sliding-window metrics query PLUS a
+    week-over-week drift check, all from segment merges — races the A
+    side: cache-off full rescans of the same current-week and
+    prior-week partitions. A traced proof pass pins
+    partitions_scanned == 0 (zero data rows read warm) and every cover
+    span a segment hit; aborts on any metric mismatch between the
+    window merge and the full rescan. Refreshes BENCH_WINDOW.json
+    (round/config preserved)."""
+    import shutil
+
+    from deequ_tpu.checks import CheckLevel, CheckStatus, DriftCheck
+    from deequ_tpu.data.table import Table
+    from deequ_tpu.repository.states import FileSystemStateRepository
+    from deequ_tpu.runners.analysis_runner import AnalysisRunner
+    from deequ_tpu.windows import Sliding, WindowQuery
+
+    n_parts = max(30, int(os.environ.get("BENCH_WINDOW_PARTS", "30")))
+    data_dir = os.environ.get("BENCH_WINDOW_DIR", "/tmp/bench_window")
+
+    t_gen = time.perf_counter()
+    write_window_dataset(n_rows, n_parts, data_dir)
+    gen_s = time.perf_counter() - t_gen
+
+    analyzers = incremental_analyzers()
+    os.environ["DEEQU_TPU_PLACEMENT"] = "device"
+    os.environ.pop("DEEQU_TPU_STATE_CACHE", None)
+
+    cache_dir = os.path.join(data_dir, "state-cache")
+    shutil.rmtree(cache_dir, ignore_errors=True)
+    repo = FileSystemStateRepository(cache_dir)
+
+    def snapshot_of(context):
+        snap = {}
+        for analyzer, metric in context.metric_map.items():
+            v = (
+                metric.value.get()
+                if metric.value.is_success
+                else type(metric.value.exception).__name__
+            )
+            if isinstance(v, float) and v != v:
+                v = "nan"
+            snap[repr(analyzer)] = v
+        return snap
+
+    source = Table.scan_parquet_dataset(data_dir, batch_rows=1 << 20)
+
+    # cold fill: one full scan commits every partition's states
+    t0 = time.perf_counter()
+    AnalysisRunner.do_analysis_run(
+        source, analyzers, state_repository=repo, dataset_name="bench",
+    )
+    cold_s = time.perf_counter() - t0
+
+    query = WindowQuery(
+        source, analyzers, repository=repo, dataset="bench",
+    )
+    timeline = query.timeline()
+    current = Sliding(7).resolve(timeline)
+    baseline = current.shifted(7, timeline)
+    parts = source.partitions()
+
+    drift_check = (
+        DriftCheck(CheckLevel.ERROR, "week-over-week")
+        .has_no_drift(
+            "x",
+            max_quantile_shift=0.2,
+            max_mean_delta=0.2,
+            max_completeness_delta=0.05,
+        )
+        .has_no_cardinality_drift("g", max_ratio_drift=0.5)
+    )
+
+    # untimed first query: publishes the segment covers (warm=True)
+    query.run(current)
+    query.run(baseline)
+
+    # A side: answer the same question by rescanning — cache-off full
+    # scans of the current-week and prior-week partitions
+    def subset_for(frame):
+        return source.subset([parts[i].path for i in frame.indices])
+
+    _drop_page_cache()
+    t0 = time.perf_counter()
+    rescan_cur = AnalysisRunner.do_analysis_run(subset_for(current), analyzers)
+    rescan_base = AnalysisRunner.do_analysis_run(subset_for(baseline), analyzers)
+    rescan_s = time.perf_counter() - t0
+
+    # B side: warm window metrics + week-over-week drift, segment merges
+    # only (zero data rows)
+    cache_dropped = _drop_page_cache()
+    t0 = time.perf_counter()
+    window_ctx = query.run(current)
+    cur_bag = query.states(current)
+    base_bag = query.states(baseline)
+    drift_result = drift_check.evaluate(current=cur_bag, baseline=base_bag)
+    window_s = time.perf_counter() - t0
+
+    # traced proof pass: zero partitions scanned, every span a hit
+    proof_ctx = query.run(current, tracing=True)
+    counters = proof_ctx.run_trace.counters
+
+    if snapshot_of(window_ctx) != snapshot_of(rescan_cur):
+        raise SystemExit(
+            "window A/B: metric mismatch between the segment merge and "
+            f"the full rescan\nrescan: {snapshot_of(rescan_cur)}\n"
+            f"window: {snapshot_of(window_ctx)}"
+        )
+    if snapshot_of(proof_ctx) != snapshot_of(rescan_cur):
+        raise SystemExit("window A/B: traced proof pass diverged")
+    # the drift inputs' provenance: the prior-week window merge must
+    # also match ITS full rescan bit-for-bit
+    if snapshot_of(query.run(baseline)) != snapshot_of(rescan_base):
+        raise SystemExit(
+            "window A/B: baseline-week metric mismatch between the "
+            "segment merge and the full rescan"
+        )
+    if counters.get("partitions_scanned", 0) != 0:
+        raise SystemExit(
+            "window A/B: warm window query scanned data rows, "
+            f"trace says {dict(counters)}"
+        )
+    if counters.get("window.segment_hits", 0) != counters.get(
+        "window.spans", -1
+    ):
+        raise SystemExit(
+            "window A/B: warm query missed segment covers, "
+            f"trace says {dict(counters)}"
+        )
+    if drift_result.status != CheckStatus.SUCCESS:
+        raise SystemExit(
+            "window A/B: drift check failed on the stable dataset: "
+            + "; ".join(
+                str(r.message)
+                for r in drift_result.constraint_results
+                if r.message
+            )
+        )
+
+    speedup = rescan_s / window_s if window_s > 0 else float("inf")
+    rec = {
+        "metric": "window_speedup",
+        "value": round(speedup, 1),
+        "unit": "x",
+        "rows": n_rows,
+        "window_ab": {
+            "n_partitions": n_parts,
+            "window": "sliding(7) + week-over-week drift",
+            "segment_merges": int(counters.get("window.segments_merged", 0)),
+            "segment_hits": int(counters.get("window.segment_hits", 0)),
+            "partitions_scanned": int(counters.get("partitions_scanned", 0)),
+            "cold_fill_s": round(cold_s, 2),
+            "full_rescan_s": round(rescan_s, 3),
+            "window_query_s": round(window_s, 3),
+            "speedup_vs_full_rescan": round(speedup, 1),
+            "drift_status": drift_result.status.value,
+            "bit_identical": True,
+            "page_cache_dropped": cache_dropped,
+            "passes": (
+                "cold fill commits per-partition states; untimed first "
+                "queries publish segment covers; cache-off rescans of "
+                "current+prior week vs warm window metrics + drift "
+                "check; traced proof pass pins partitions_scanned == 0 "
+                "and all covers hit"
+            ),
+        },
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "BENCH_WINDOW.json")
+    try:
+        with open(out_path) as fh:
+            old = json.load(fh)
+        for key in ("round", "config"):
+            if key in old and key not in rec:
+                rec[key] = old[key]
+    except Exception:  # noqa: BLE001 - first write: no fields to carry
+        pass
+    with open(out_path, "w") as fh:
+        json.dump(rec, fh)
+        fh.write("\n")
+    print(
+        f"# bench: window A/B rescan={rescan_s:.3f}s window={window_s:.3f}s "
+        f"({speedup:.1f}x), {counters.get('window.segments_merged')} segment "
+        f"merges, 0 rows read (cold fill {cold_s:.2f}s); gen={gen_s:.1f}s",
+        file=sys.stderr,
+    )
+    print(json.dumps(rec))
+
+
 def _stream_shape() -> str:
     return os.environ.get("BENCH_STREAM_SHAPE", "default")
 
@@ -2170,6 +2403,11 @@ def main() -> None:
     if mode == "incremental":
         # self-contained A/B with its own JSON record and artifact
         run_incremental_bench(n_rows)
+        return
+
+    if mode == "window":
+        # self-contained A/B with its own JSON record and artifact
+        run_window_bench(n_rows)
         return
 
     if mode == "reader":

@@ -5,6 +5,12 @@ from deequ_tpu.checks.check import (
     CheckStatus,
     CheckWithLastConstraintFilterable,
 )
+from deequ_tpu.checks.drift import (
+    DriftCheck,
+    DriftCheckResult,
+    DriftConstraint,
+    DriftConstraintResult,
+)
 
 __all__ = [
     "Check",
@@ -12,4 +18,8 @@ __all__ = [
     "CheckResult",
     "CheckStatus",
     "CheckWithLastConstraintFilterable",
+    "DriftCheck",
+    "DriftCheckResult",
+    "DriftConstraint",
+    "DriftConstraintResult",
 ]

@@ -244,6 +244,16 @@ class PlanCost:
     #: `quota_scan_bytes`; negative means the plan overdraws the window
     #: and DQ319 fires when it can NEVER fit
     quota_headroom_bytes: Optional[float] = None
+    #: windowed query (windows/query.py): the window spec text, how many
+    #: segment envelopes the merge tree touches, how many member
+    #: partitions must rescan (no usable cached state), and the member
+    #: bytes the segment algebra avoids reading — rendered in EXPLAIN's
+    #: `windows:` line and pinned against the observed `window.*` trace
+    #: counters. window_spec None = not a window query.
+    window_spec: Optional[str] = None
+    window_segments_merged: int = 0
+    window_partitions_rescanned: int = 0
+    saved_window_bytes: float = 0.0
 
     @property
     def shard_partitions_max(self) -> int:
@@ -457,6 +467,19 @@ def cost_drift(cost: "PlanCost", trace: Any) -> Dict[str, float]:
                 int(trace.counters.get("shard.partitions_max", 0))
                 - cost.shard_partitions_max
             )
+
+    # window pins: the cover decomposition is deterministic, so a warm
+    # window query must merge exactly the predicted number of segment
+    # envelopes and rescan exactly the predicted partitions
+    if cost.window_spec is not None and "window.segments_merged" in trace.counters:
+        out["drift.window_segments_merged"] = float(
+            int(trace.counters.get("window.segments_merged", 0))
+            - cost.window_segments_merged
+        )
+        out["drift.window_partitions_rescanned"] = float(
+            int(trace.counters.get("window.partitions_rescanned", 0))
+            - cost.window_partitions_rescanned
+        )
     return out
 
 

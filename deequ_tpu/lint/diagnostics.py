@@ -59,6 +59,9 @@ CODES = {
     # fleet-level scan sharing (plan-subsumption prover, lint/subsume.py)
     "DQ321": "suite provably contained in a shared scan",
     "DQ322": "scan sharing declined; obligation not provably contained",
+    # windowed metrics / drift (windows/, checks/drift.py)
+    "DQ323": "window not resolvable from precomputed segments",
+    "DQ324": "drift baseline missing or plan-signature mismatched",
 }
 
 

@@ -556,6 +556,13 @@ def render_explain(
                 "  per-batch wire time unmeasured "
                 "(no cached link-bandwidth probe)"
             )
+    if cost.window_spec is not None:
+        body.append(
+            f"windows: {cost.window_spec} -> "
+            f"{cost.window_segments_merged} segment merges, "
+            f"{cost.window_partitions_rescanned} partitions rescanned "
+            f"(saves ~{_fmt_bytes(cost.saved_window_bytes)} read)"
+        )
     if cost.admission_tier is not None:
         scan_bytes = cost.predicted_scan_bytes
         line = (

@@ -213,3 +213,23 @@ def record_state_cache(cached: int, scanned: int, total: int) -> None:
         tracer.count("partitions_cached", int(cached))
         tracer.count("partitions_scanned", int(scanned))
         tracer.count("partitions_total", int(total))
+
+
+def record_window(
+    segments: int, hits: int, built: int, rescanned: int, partitions: int
+) -> None:
+    """Segment-merge outcome of one window query (windows/query.py):
+    cover spans merged, of which segment-envelope hits vs lazily built,
+    plus partitions that had to rescan out of the window's member
+    count. Tracer-only, like record_state_cache; the counters feed
+    cost_drift's `drift.window_*` pins and the
+    `engine.window.segment_hit_ratio` telemetry series the sentinel
+    watches."""
+    tracer = spans.current_tracer()
+    if tracer is not None:
+        tracer.count("window.spans", int(segments))
+        tracer.count("window.segments_merged", int(segments))
+        tracer.count("window.segment_hits", int(hits))
+        tracer.count("window.segments_built", int(built))
+        tracer.count("window.partitions_rescanned", int(rescanned))
+        tracer.count("window.partitions", int(partitions))

@@ -228,6 +228,17 @@ def engine_metric_record(
             rec.get("engine.counter.partitions_cached", 0.0) / partitions_total
         )
 
+    # derived: fraction of a window query's cover spans answered by a
+    # precomputed segment envelope (the rest rebuilt from per-partition
+    # states) — the sentinel watches it collapsing, which means segment
+    # publication broke or churn outruns the covers; only present when
+    # a window query actually resolved spans
+    window_spans = rec.get("engine.counter.window.spans", 0.0)
+    if window_spans > 0.0:
+        rec["engine.window.segment_hit_ratio"] = (
+            rec.get("engine.counter.window.segment_hits", 0.0) / window_spans
+        )
+
     # derived: fraction of fused-fn lookups that found their plan
     # *shape* already compiled (the jit/fuse cost paid once per shape
     # fleet-wide) — the sentinel watches it dropping; only present when

@@ -791,6 +791,12 @@ def record_state_cache(cached: int, scanned: int, total: int) -> None:
     _counters.record_state_cache(cached, scanned, total)
 
 
+def record_window(
+    segments: int, hits: int, built: int, rescanned: int, partitions: int
+) -> None:
+    _counters.record_window(segments, hits, built, rescanned, partitions)
+
+
 def record_reader_chunks(native: int, fallback: int, total: int) -> None:
     _counters.record_reader_chunks(native, fallback, total)
 

@@ -35,6 +35,7 @@ __all__ = [
     "engine_series",
     "persist_engine_record",
     "record_run",
+    "record_window_run",
 ]
 
 ENGINE_TELEMETRY_TAG = "telemetry"
@@ -162,6 +163,54 @@ def record_run(
     )
     persist_engine_record(repository, record, key, instance=instance)
     return key
+
+
+def record_window_run(
+    repository: MetricsRepository,
+    trace: Any,
+    drift_result: Any = None,
+    plan_cost: Any = None,
+    *,
+    suite: str,
+    dataset: str,
+    data_set_date: Optional[int] = None,
+    tags: Optional[Dict[str, str]] = None,
+    instance: str = "engine",
+) -> ResultKey:
+    """`record_run` for a window query + optional drift evaluation: the
+    trace contributes the `engine.window.*` counters (and the derived
+    `engine.window.segment_hit_ratio`), and a `DriftCheckResult` adds
+    `engine.drift.value_max` (the worst drift measure observed) and
+    `engine.drift.failed_constraints` — the two series the sentinel
+    watches for a drifting dataset."""
+    extra: Dict[str, float] = {}
+    if drift_result is not None:
+        values = [
+            float(r.value)
+            for r in drift_result.constraint_results
+            if r.value is not None and r.value == r.value
+        ]
+        finite = [v for v in values if v != float("inf")]
+        if finite:
+            extra["engine.drift.value_max"] = max(finite)
+        extra["engine.drift.failed_constraints"] = float(
+            sum(
+                1
+                for r in drift_result.constraint_results
+                if getattr(r.status, "name", "") != "SUCCESS"
+            )
+        )
+    return record_run(
+        repository,
+        trace,
+        plan_cost,
+        suite=suite,
+        dataset=dataset,
+        data_set_date=data_set_date,
+        tags=tags,
+        instance=instance,
+        extra=extra or None,
+    )
 
 
 def _engine_results(

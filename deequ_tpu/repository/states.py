@@ -383,6 +383,18 @@ class StateRepository:
     def _exists(self, dataset: str, signature: str, fingerprint: str) -> bool:
         raise NotImplementedError
 
+    # -- raw envelope surface (windows/segments.py and other layered
+    # -- caches store their own self-validated envelopes here) ---------------
+
+    def get_blob(self, dataset: str, signature: str, key: str) -> Optional[bytes]:
+        return self._get(dataset, signature, key)
+
+    def put_blob(self, dataset: str, signature: str, key: str, blob: bytes) -> None:
+        self._put(dataset, signature, key, blob)
+
+    def has_blob(self, dataset: str, signature: str, key: str) -> bool:
+        return self._exists(dataset, signature, key)
+
     # -- the cache surface the fused pass consumes ---------------------------
 
     def has_states(self, dataset: str, fingerprint: str, signature: str) -> bool:

@@ -89,6 +89,30 @@ class AdmissionController:
                     cost=cost,
                 )
 
+        return self.decide(
+            tenant,
+            dataset,
+            cost,
+            pending_count=pending_count,
+            state_disk_usage=state_disk_usage,
+        )
+
+    def decide(
+        self,
+        tenant: str,
+        dataset: str,
+        cost: Optional[PlanCost],
+        *,
+        pending_count: int,
+        state_disk_usage: Optional[int] = None,
+    ) -> AdmissionDecision:
+        """Gates 2-3 + tier classification over an already-computed
+        `PlanCost` — the entry point for submissions that cost
+        themselves (window queries cost their own merge tree via
+        `WindowQuery.admission_cost`; `evaluate` delegates here after
+        its EXPLAIN gate)."""
+        quota = self._ledger.quota(tenant)
+
         # gate 2 — tenant budgets that are knowable before running
         if pending_count >= quota.max_pending:
             return AdmissionDecision(
@@ -127,7 +151,7 @@ class AdmissionController:
                 cost=cost,
             )
 
-        tier = cost.admission_tier or "batch"
+        tier = (cost.admission_tier if cost is not None else None) or "batch"
         return AdmissionDecision(admitted=True, tier=tier, cost=cost)
 
 

@@ -319,6 +319,126 @@ class DQService:
             self._cv.notify_all()
         return handle
 
+    def submit_window(
+        self,
+        tenant: str,
+        dataset: str,
+        source: Any,
+        *,
+        window: Any,
+        analyzers: Sequence[Any],
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        extractor: Any = None,
+        warm: bool = True,
+    ) -> SubmissionHandle:
+        """Submit a windowed metrics query (windows/query.py) as an
+        ordinary admission-costed submission. The plan costs itself via
+        `WindowQuery.admission_cost` — on warm segments the predicted
+        scan bytes are near zero, so a per-ingest-tick windowed suite
+        admits as 'interactive' and never competes with real scans.
+        The handle's result is the window's `AnalyzerContext` (with
+        `window_plan` attached)."""
+        from ..windows.query import WindowQuery
+
+        handle = SubmissionHandle(tenant, dataset)
+        handle.attempts = 0
+        self.telemetry.count("submitted")
+
+        with self._cv:
+            if not self._accepting:
+                return self._finalize_locked_handle(
+                    handle, "drained", DQ_DRAINED,
+                    "service is draining; resubmit after restart",
+                )
+            pending = self._pending.get(tenant, 0)
+
+        if self._state_repository is None:
+            self.telemetry.count("rejected")
+            return self._finalize_locked_handle(
+                handle, "rejected", DQ_REJECTED,
+                "window submissions need a state repository "
+                "(the merge tree resolves against cached states)",
+            )
+        if self.breakers.open_now(tenant, dataset):
+            self.telemetry.count("rejected")
+            return self._finalize_locked_handle(
+                handle, "rejected", DQ_BREAKER_OPEN,
+                f"circuit breaker open for ({tenant!r}, {dataset!r})",
+            )
+
+        try:
+            src = source() if callable(source) else source
+            query = WindowQuery(
+                src,
+                list(analyzers),
+                repository=self._state_repository,
+                dataset=self._state_dataset(tenant, dataset),
+                extractor=extractor,
+            )
+            cost = query.admission_cost(window)
+        except Exception as exc:  # noqa: BLE001 — containment: a bad
+            # source or spec is the submission's failure, not the pool's
+            self.breakers.record_failure(tenant, dataset)
+            self.telemetry.count("failed")
+            handle.error = exc
+            return self._finalize_locked_handle(
+                handle, "failed", None,
+                f"window plan failed: {type(exc).__name__}: {exc}",
+            )
+        try:
+            decision = self._admission.decide(
+                tenant,
+                dataset,
+                cost,
+                pending_count=pending,
+                state_disk_usage=self._state_disk_usage(tenant, dataset),
+            )
+        except faults.InjectedFaultError as exc:
+            self.telemetry.count("admission_faults")
+            return self._finalize_locked_handle(
+                handle, "rejected", DQ_REJECTED,
+                f"admission unavailable: {exc}",
+            )
+        if not decision.admitted:
+            self.telemetry.count("rejected")
+            handle.cost = decision.cost
+            publish_event(
+                "service.rejected",
+                tenant=tenant, dataset=dataset, code=decision.code,
+            )
+            return self._finalize_locked_handle(
+                handle, "rejected", decision.code, decision.reason,
+            )
+
+        self.telemetry.count("admitted")
+        tier = decision.tier or "batch"
+        handle.tier = tier
+        handle.cost = decision.cost
+
+        def run_window():
+            return query.run(window, warm=warm, tracing=True)
+
+        sub = _Submission(
+            tenant, dataset, run_window, (), tuple(analyzers), priority,
+            deadline_s, self._clock(), handle, tier, decision.cost,
+            next(self._seq), "window",
+        )
+        with self._cv:
+            if not self._accepting:
+                return self._finalize_locked_handle(
+                    handle, "drained", DQ_DRAINED,
+                    "service began draining during admission",
+                )
+            if not self._enqueue_locked(sub):
+                return handle  # shed; handle already finalized
+            self._pending[tenant] = self._pending.get(tenant, 0) + 1
+            handle.status = "queued"
+            if tier == "interactive":
+                self._maybe_preempt_locked()
+            self._cv.notify_all()
+        return handle
+
     def _enqueue_locked(self, sub: _Submission) -> bool:
         """FIFO enqueue with shed-on-overload. Returns False when the
         new submission itself was shed."""
@@ -556,6 +676,18 @@ class DQService:
             sub.controller = ctl
         try:
             faults.fault_point("service.worker")
+            if sub.engine == "window":
+                # windowed query: the submission carries its own
+                # executor closure (WindowQuery.run) — no suite, no
+                # scan; zero data rows on warm segments
+                result = sub.data()
+                self.breakers.record_success(sub.tenant, sub.dataset)
+                self.telemetry.count("completed")
+                handle.result = result
+                with self._cv:
+                    self._decrement_pending_locked(sub)
+                    self._finalize_locked_handle(handle, "done", None, "")
+                return
             table = sub.data() if callable(sub.data) else sub.data
             builder = VerificationSuite().on_data(table).with_controller(ctl)
             for check in sub.checks:

@@ -80,9 +80,10 @@ FAULT_KINDS: Dict[str, str] = {
     # staged stream pipeline (ops/pipeline.py)
     "pipeline.stage": "raise",  # the stage worker raises mid-batch
     "pipeline.stall": "sleep",  # the stage worker wedges on one batch
-    # state repository (repository/states.py)
+    # state repository (repository/states.py, windows/segments.py)
     "state.save": "raise",     # the per-partition state commit fails
     "state.load": "raise",     # a cached-state read fails
+    "state.segment": "raise",  # a DQSG segment envelope read/write fails
     # DQ service (service/): the fleet-scale execution layer
     "service.worker": "raise",     # a pool worker dies executing a run
     "service.scheduler": "sleep",  # the scheduler housekeeping tick wedges

@@ -1,0 +1,143 @@
+"""Windowed metrics and week-over-week drift detection.
+
+A daily-partitioned dataset accumulates one parquet file per day. An
+ordinary analysis run commits each partition's analyzer STATES to a
+repository as it scans — after that, any time window (last 7 days, this
+week vs last week) is answered by merging a handful of precomputed
+segment states (deequ_tpu/windows/) with ZERO data rows read, and a
+`DriftCheck` compares two windows state-vs-state: KS distance between
+quantile sketches, cardinality ratios between HLLs, completeness and
+moment deltas — no rescans of either side.
+
+The script bootstraps two stable weeks, shows the warm window query
+resolving from segments, then injects a skewed day and watches the
+week-over-week drift check fail.
+"""
+
+import datetime
+import os
+import tempfile
+
+import numpy as np
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    ApproxQuantile,
+    Completeness,
+    Mean,
+    Size,
+    StandardDeviation,
+)
+from deequ_tpu.checks import CheckLevel, DriftCheck
+from deequ_tpu.data.table import ColumnType, Table
+from deequ_tpu.repository.states import FileSystemStateRepository
+from deequ_tpu.runners.analysis_runner import AnalysisRunner
+from deequ_tpu.windows import Sliding, WindowQuery
+
+DAY0 = datetime.date(2026, 6, 1)
+
+ANALYZERS = [
+    Size(),
+    Completeness("latency_ms"),
+    Mean("latency_ms"),
+    StandardDeviation("latency_ms"),
+    ApproxQuantile("latency_ms", 0.5),
+    ApproxCountDistinct("endpoint"),
+]
+
+
+def write_day(dir_path: str, day_index: int, *, skewed: bool = False) -> None:
+    """One day of request-latency telemetry; a skewed day models a
+    regression (slower, spikier, nullier, new endpoints)."""
+    rng = np.random.default_rng(100 + day_index)
+    n = 2_000
+    mean, scale, nulls, endpoints = (
+        (240.0, 80.0, 0.25, 900) if skewed else (120.0, 25.0, 0.02, 150)
+    )
+    latency = rng.normal(mean, scale, n)
+    latency[rng.random(n) < nulls] = np.nan
+    table = Table.from_pydict(
+        {
+            "latency_ms": list(latency),
+            "endpoint": [int(v) for v in rng.integers(0, endpoints, n)],
+        },
+        types={"latency_ms": ColumnType.DOUBLE, "endpoint": ColumnType.LONG},
+    )
+    day = DAY0 + datetime.timedelta(days=day_index)
+    table.to_parquet(
+        os.path.join(dir_path, f"requests-{day.isoformat()}.parquet")
+    )
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as workdir:
+        data_dir = os.path.join(workdir, "requests")
+        os.makedirs(data_dir)
+        for i in range(14):  # two stable weeks
+            write_day(data_dir, i)
+
+        repository = FileSystemStateRepository(os.path.join(workdir, "states"))
+
+        # the nightly scan: computes metrics AND commits per-partition
+        # states — the only pass that ever reads data rows
+        source = Table.scan_parquet_dataset(data_dir)
+        AnalysisRunner.do_analysis_run(
+            source, ANALYZERS, state_repository=repository,
+            dataset_name="requests",
+        )
+
+        query = WindowQuery(
+            source, ANALYZERS, repository=repository, dataset="requests"
+        )
+        window = Sliding(7)  # "the last 7 days", resolved per query
+
+        context = query.run(window)  # publishes the segment covers
+        plan = context.window_plan
+        print(f"window plan: {plan.summary()}")
+        print("last-7-days metrics (zero rows read on the warm path):")
+        for analyzer, metric in context.metric_map.items():
+            print(f"\t{analyzer!r}: {metric.value.get():.4f}")
+
+        check = (
+            DriftCheck(CheckLevel.ERROR, "week-over-week regression gate")
+            .has_no_quantile_drift("latency_ms", max_quantile_shift=0.15)
+            .has_no_mean_drift("latency_ms", max_relative_delta=0.10)
+            .has_no_completeness_drift("latency_ms", max_delta=0.05)
+            .has_no_cardinality_drift("endpoint", max_ratio_drift=0.50)
+        )
+
+        def week_over_week() -> None:
+            timeline = query.timeline()
+            this_week = window.resolve(timeline)
+            last_week = this_week.shifted(7, timeline)
+            result = check.evaluate(
+                current=query.states(this_week),
+                baseline=query.states(last_week),
+            )
+            print(f"drift status: {result.status.name}")
+            for r in result.constraint_results:
+                value = "-" if r.value is None else f"{r.value:.4f}"
+                print(f"\t[{r.status.name:7s}] {r.constraint.description}"
+                      f" (observed {value})")
+
+        print("\nweek over week, both weeks stable:")
+        week_over_week()
+
+        # day 14 ships a regression: slower, spikier, nullier, and
+        # hitting endpoints nobody saw last week
+        write_day(data_dir, 14, skewed=True)
+        source = Table.scan_parquet_dataset(data_dir)
+        AnalysisRunner.do_analysis_run(
+            source, ANALYZERS, state_repository=repository,
+            dataset_name="requests",
+        )
+        query = WindowQuery(
+            source, ANALYZERS, repository=repository, dataset="requests"
+        )
+
+        print("\nweek over week after the skewed day landed:")
+        week_over_week()
+
+
+if __name__ == "__main__":
+    main()

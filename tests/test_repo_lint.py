@@ -871,3 +871,87 @@ def test_faults_rule_covers_service_files():
         path = os.path.join(REPO, rel)
         assert lint.check_fault_containment(path) == [], rel
         assert lint.check_fault_registration(path, registered) == [], rel
+
+
+# -- WINDOWS: purity of the windowed state algebra + drift math ---------------
+
+
+def test_windows_checker_flags_jax_import_even_lazy():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def merge(entries):\n"
+        "    import jax.numpy as jnp\n"
+        "    return jnp.sum(jnp.asarray(entries))\n"
+    )
+    try:
+        findings = lint.check_windows_purity(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+    assert "WINDOWS" in findings[0] and "jax" in findings[0]
+
+
+def test_windows_checker_flags_pyarrow_and_ops_imports():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import pyarrow.parquet as pq\n"
+        "def peek():\n"
+        "    from deequ_tpu.ops import runtime\n"
+        "    return runtime\n"
+    )
+    try:
+        findings = lint.check_windows_purity(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 2
+    assert all("WINDOWS" in f for f in findings)
+    assert any("pyarrow" in f for f in findings)
+    assert any("deequ_tpu.ops" in f for f in findings)
+
+
+def test_windows_checker_flags_open_call():
+    lint = _lint_module()
+    path = _tmp_source(
+        "def load(path):\n"
+        "    with open(path, 'rb') as f:\n"
+        "        return f.read()\n"
+    )
+    try:
+        findings = lint.check_windows_purity(path)
+    finally:
+        os.unlink(path)
+    assert len(findings) == 1
+    assert "WINDOWS" in findings[0] and "open" in findings[0]
+
+
+def test_windows_checker_allows_numpy_and_state_imports():
+    lint = _lint_module()
+    path = _tmp_source(
+        "import numpy as np\n"
+        "from deequ_tpu.repository.states import decode_states\n"
+        "from deequ_tpu.testing import faults\n"
+        "def fold(blobs, analyzers):\n"
+        "    return [decode_states(b, analyzers) for b in blobs]\n"
+    )
+    try:
+        findings = lint.check_windows_purity(path)
+    finally:
+        os.unlink(path)
+    assert findings == []
+
+
+def test_windows_rule_covers_the_subsystem_and_it_is_clean():
+    lint = _lint_module()
+    sep = os.sep
+    assert f"deequ_tpu{sep}analyzers{sep}drift.py" in lint.WINDOWS_EXTRA_FILES
+    windows_dir = os.path.join(lint.REPO, lint.WINDOWS_DIR)
+    files = [
+        os.path.join(windows_dir, f)
+        for f in os.listdir(windows_dir)
+        if f.endswith(".py")
+    ]
+    assert files, "windows/ package has no modules?"
+    for path in files + [
+        os.path.join(lint.REPO, rel) for rel in lint.WINDOWS_EXTRA_FILES
+    ]:
+        assert lint.check_windows_purity(path) == [], path

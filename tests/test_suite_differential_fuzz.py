@@ -1275,3 +1275,129 @@ def test_scan_sharing_shared_vs_solo_bit_identical(seed, monkeypatch, tmp_path):
                 if h.sharing is not None and h.sharing["shared"]
             )
             assert shared_n >= 2, f"group never formed on {placement}"
+
+
+# -- windowed state algebra: window query vs full rescan (ISSUE 18) -----------
+
+
+def _context_bits(context) -> dict:
+    """Bit-exact snapshot of an AnalyzerContext's metric map: floats
+    compare by their f64 bit pattern (NaN payloads and -0.0 included),
+    everything else by value."""
+    import struct as _struct
+
+    snap = {}
+    for analyzer, metric in context.metric_map.items():
+        v = (
+            metric.value.get()
+            if metric.value.is_success
+            else type(metric.value.exception).__name__
+        )
+        if isinstance(v, float):
+            v = _struct.pack(">d", v)
+        snap[repr(analyzer)] = v
+    return snap
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_window_query_vs_full_rescan_bit_identical(seed, monkeypatch, tmp_path):
+    """A window query answered from the segment-merge tree must be
+    BIT-identical to scanning exactly the window's member partitions —
+    across random specs (tumbling/sliding/last-N), sparse calendars,
+    cold and warm repositories, a late-arriving partition, and a
+    re-stated (rewritten) partition. The merge is the engine's own
+    sequential name-order fold, so equality here is exact snapshot
+    equality, sketches included."""
+    import datetime as _dt
+
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        ApproxQuantile,
+        Completeness,
+        Maximum,
+        Mean,
+        Minimum,
+        Size,
+        StandardDeviation,
+    )
+    from deequ_tpu.data.table import Table as TableCls
+    from deequ_tpu.repository.states import FileSystemStateRepository
+    from deequ_tpu.runners.analysis_runner import AnalysisRunner
+    from deequ_tpu.windows import LastN, Sliding, Tumbling, WindowQuery
+
+    rng = np.random.default_rng(18_000 + seed)
+    monkeypatch.setenv(
+        "DEEQU_TPU_PLACEMENT", str(rng.choice(["host", "device"]))
+    )
+    monkeypatch.delenv("DEEQU_TPU_STATE_CACHE", raising=False)
+
+    day0 = _dt.date(2026, 3, 1)
+    n_parts = int(rng.integers(8, 17))
+    if rng.random() < 0.5:  # sparse calendar: gaps inside the cover
+        days = sorted(
+            int(d)
+            for d in rng.choice(n_parts * 2, size=n_parts, replace=False)
+        )
+    else:
+        days = list(range(n_parts))
+
+    data_dir = tmp_path / "dataset"
+    data_dir.mkdir()
+
+    def day_path(d: int) -> str:
+        name = f"part-{(day0 + _dt.timedelta(days=d)).isoformat()}.parquet"
+        return str(data_dir / name)
+
+    for d in days:
+        _write_partition(random_table(rng), day_path(d))
+
+    analyzers = [
+        Size(),
+        Completeness("x"),
+        Mean("x"),
+        StandardDeviation("x"),
+        Minimum("x"),
+        Maximum("y"),
+        ApproxCountDistinct("g"),
+        ApproxQuantile("x", 0.5),
+    ]
+    span = int(rng.integers(2, 9))
+    spec = [
+        Tumbling(span),
+        Sliding(span),
+        LastN(span, unit=str(rng.choice(["days", "partitions"]))),
+    ][int(rng.integers(0, 3))]
+    repo = FileSystemStateRepository(str(tmp_path / "cache"))
+
+    def check_step(step):
+        source = TableCls.scan_parquet_dataset(str(data_dir))
+        query = WindowQuery(
+            source, analyzers, repository=repo, dataset="fuzz"
+        )
+        frame = spec.resolve(query.timeline())
+        if not frame.indices:
+            return
+        window_ctx = query.run(frame)
+        parts = source.partitions()
+        rescan_ctx = AnalysisRunner.do_analysis_run(
+            source.subset([parts[i].path for i in frame.indices]), analyzers
+        )
+        assert _context_bits(window_ctx) == _context_bits(rescan_ctx), (
+            step,
+            seed,
+            repr(spec),
+        )
+
+    check_step("cold")  # rescan-fill + segment publish
+    check_step("warm")  # pure segment merges
+
+    late = max(days) + int(rng.integers(1, 4))
+    _write_partition(random_table(rng), day_path(late))
+    days.append(late)
+    check_step("late")  # late arrival invalidates only covering spans
+
+    _write_partition(
+        random_table(rng), day_path(days[int(rng.integers(0, len(days)))])
+    )
+    check_step("restate")  # rewritten fingerprint self-invalidates
+    check_step("warm2")  # the rebuilt covers serve the repeat

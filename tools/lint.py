@@ -108,6 +108,7 @@ Exit code 0 = clean, 1 = findings. Run via `make lint` or directly:
 from __future__ import annotations
 
 import ast
+import glob
 import os
 import shutil
 import subprocess
@@ -160,6 +161,17 @@ SUBSUME_FORBIDDEN_PREFIXES = (
     "deequ_tpu.parallel",
     "deequ_tpu.verification",
 )
+# Windowed state algebra + drift math: host-side planning and
+# host-side numpy statistics only. No jax/pyarrow/pandas (a window
+# query must resolve with zero data rows read and no kernel dispatch),
+# and no deequ_tpu.ops imports (sketch behavior is reached through the
+# state objects' own methods); numpy IS allowed — the drift statistics
+# are host arithmetic. `open(...)` is banned: all persistence goes
+# through the StateRepository surface.
+WINDOWS_DIR = os.path.join("deequ_tpu", "windows")
+WINDOWS_EXTRA_FILES = [os.path.join("deequ_tpu", "analyzers", "drift.py")]
+WINDOWS_FORBIDDEN_MODULES = {"jax", "jaxlib", "pyarrow", "pandas"}
+WINDOWS_FORBIDDEN_PREFIXES = ("deequ_tpu.ops",)
 # Fast-path decode modules: buffer-level only, no host-copy idioms
 # outside designated fallback functions (names ending `_fallback`).
 DECODE_FILES = [
@@ -447,6 +459,57 @@ def check_subsume_purity(path: str) -> List[str]:
                 f"{_rel(path)}:{node.lineno}: SUBSUME `open(...)` in the "
                 f"subsumption prover — it must never touch files; plans "
                 f"and schemas arrive as arguments"
+            )
+    return findings
+
+
+# -- WINDOWS: purity of the windowed state algebra + drift math ---------------
+
+
+def check_windows_purity(path: str) -> List[str]:
+    """Flag accelerator/table-IO imports and `open(...)` calls in the
+    windows/ package and the drift statistics: a window query answers
+    from persisted states alone (zero rows read, no kernel dispatch),
+    and the drift math is host-side numpy — jax, pyarrow, pandas, and
+    `deequ_tpu.ops` must never appear on that path."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    findings: List[str] = []
+    # relative-import base from the file's own package
+    pkg = os.path.dirname(_rel(path)).replace(os.sep, ".")
+    for node in ast.walk(tree):
+        modules: List[str] = []
+        if isinstance(node, ast.Import):
+            modules = [alias.name for alias in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                parts = pkg.split(".")
+                base = ".".join(parts[: len(parts) - node.level + 1])
+                modules = [f"{base}.{node.module}" if node.module else base]
+            elif node.module:
+                modules = [node.module]
+        for mod in modules:
+            bad = mod.split(".")[0] in WINDOWS_FORBIDDEN_MODULES or any(
+                mod == p or mod.startswith(p + ".")
+                for p in WINDOWS_FORBIDDEN_PREFIXES
+            )
+            if bad:
+                findings.append(
+                    f"{_rel(path)}:{node.lineno}: WINDOWS `{mod}` import "
+                    f"on the windowed-query/drift path — windows resolve "
+                    f"from persisted states with zero rows read, and "
+                    f"drift math is host-side numpy (no jax/pyarrow/"
+                    f"pandas, no deequ_tpu.ops)"
+                )
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "open"
+        ):
+            findings.append(
+                f"{_rel(path)}:{node.lineno}: WINDOWS `open(...)` on the "
+                f"windowed-query/drift path — all persistence goes "
+                f"through the StateRepository surface"
             )
     return findings
 
@@ -1019,6 +1082,19 @@ def main() -> int:
         path = os.path.join(REPO, rel)
         if os.path.exists(path):
             findings.extend(check_subsume_purity(path))
+
+    windows_dir = os.path.join(REPO, WINDOWS_DIR)
+    windows_paths = (
+        sorted(glob.glob(os.path.join(windows_dir, "*.py")))
+        if os.path.isdir(windows_dir)
+        else []
+    ) + [
+        os.path.join(REPO, rel)
+        for rel in WINDOWS_EXTRA_FILES
+        if os.path.exists(os.path.join(REPO, rel))
+    ]
+    for path in windows_paths:
+        findings.extend(check_windows_purity(path))
 
     for rel in DECODE_FILES:
         path = os.path.join(REPO, rel)

@@ -110,6 +110,25 @@ WATCHED_SERIES: Sequence[Tuple[str, str]] = (
     # payloads growing, partition counts exploding) — rows never cross,
     # so this must stay KB-scale
     ("engine.shard.merge_bytes", "up"),
+    # windowed-query segment effectiveness: the fraction of a window's
+    # cover spans answered by a precomputed DQSG segment envelope; a
+    # collapse means segment publication broke (warm=False everywhere,
+    # put_blob failing silently) or partition churn outruns the covers
+    ("engine.window.segment_hit_ratio", "down"),
+    # windowed-query rescan pressure: member partitions with no usable
+    # cached state; a rise means the per-partition state commit path
+    # regressed (serde failures, signature churn) and window queries are
+    # quietly turning back into scans
+    ("engine.window.partitions_rescanned", "up"),
+    # dataset drift: the worst two-sample drift measure a DriftCheck
+    # observed (KS distance, cardinality ratio, completeness/moment
+    # deltas); a rise means the watched dataset's distribution is moving
+    # against its baseline window
+    ("engine.drift.value_max", "up"),
+    # drift constraint failures per evaluation; any sustained rise means
+    # a dataset is actively violating its drift contract (or the
+    # baseline wiring broke — DQ324 failures count here too)
+    ("engine.drift.failed_constraints", "up"),
 )
 
 #: phases whose share of wall time is watched (rises are bad: a phase
