@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: lint typecheck analyze sentinel test test-fast trace-demo chaos service-chaos bench-pushdown bench-decode bench-wire bench-incremental bench-reader bench-forensics bench-chaos bench-service bench-mesh bench-sharing bench-window clean-native
+.PHONY: lint typecheck analyze sentinel test test-fast trace-demo chaos service-chaos bench-pushdown bench-decode bench-wire bench-incremental bench-reader bench-encfold bench-forensics bench-chaos bench-service bench-mesh bench-sharing bench-window clean-native
 
 lint:
 	$(PY) tools/lint.py
@@ -75,6 +75,16 @@ bench-incremental:
 BENCH_READER_ROWS ?= 4000000
 bench-reader:
 	JAX_PLATFORMS=cpu BENCH_MODE=reader BENCH_ROWS=$(BENCH_READER_ROWS) $(PY) bench.py
+
+# encoded-data fold A/B on the low-cardinality half of the 50-column
+# wide-stream shape: same plan with DEEQU_TPU_ENCODED_FOLD=0 (row-width
+# expansion) then =1 (run/dictionary folding), native reader on both
+# sides, bit-identity asserted — the bench ABORTS on any metric
+# mismatch or plan/runtime drift. Refreshes BENCH_ENCFOLD.json
+# (methodology: BENCH.md round 20)
+BENCH_ENCFOLD_ROWS ?= 4000000
+bench-encfold:
+	JAX_PLATFORMS=cpu BENCH_MODE=encfold BENCH_ROWS=$(BENCH_ENCFOLD_ROWS) $(PY) bench.py
 
 # failure-forensics capture A/B on the wide-stream shape: the same
 # verification run with .with_forensics() off then on, bit-identity

@@ -1338,6 +1338,354 @@ def run_reader_bench(n_rows: int) -> None:
     print(json.dumps(rec))
 
 
+def encfold_analyzers():
+    """The encoded-fold plan for BENCH_MODE=encfold: the LOW-CARDINALITY
+    half of the 50-column wide stream — the 19 quantized-decimal f
+    columns (200-10000 distinct values each, the TPC-H money shape) and
+    the 10 windowed int columns. ApproxCountDistinct makes every f
+    column a sketch consumer (dictionary-code rollup); Mean over the
+    ints rides the footer-proven moments memos (Σ run_len × value over
+    RLE runs); the median beside it makes each of those columns a
+    select-family job, whose published qkey/rkey memos serve quantile
+    AND distinct-count without a row in sight; Completeness everywhere
+    folds definition-level runs.
+    The i%4==3 f columns (10000 distinct values — past the per-batch
+    DISTINCT_PUBLISH_CAP, so a sketch consumer would decline
+    publication and expand the stub every batch) carry Completeness
+    only: null counts come straight from the def-runs. Column pruning
+    drops f00 (continuous lognormal), the bools and the strings, so
+    the A/B isolates run-folding against row-width expansion of the
+    exact columns the tentpole targets."""
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        ApproxQuantile,
+        Completeness,
+        Mean,
+    )
+
+    names = [f"f{i:02d}" for i in range(1, 20)] + [
+        f"i{i:02d}" for i in range(10)
+    ]
+    out = [Completeness(c) for c in names]
+    out += [
+        ApproxCountDistinct(f"f{i:02d}") for i in range(1, 20) if i % 4 != 3
+    ]
+    out += [
+        ApproxQuantile(f"f{i:02d}", 0.5) for i in range(1, 20) if i % 4 != 3
+    ]
+    out += [Mean(f"i{i:02d}") for i in range(10)]
+    return out
+
+
+def _encfold_span_stats(roots):
+    """Runtime encoded-fold tallies from a traced pass: summed
+    `page_decode` run/chunk verdicts. The span sums are the runtime
+    twin of the traced encfold_* counters — equal when no decode unit
+    went uncounted."""
+    stats = {
+        "runs_native": 0,
+        "chunks_runs": 0,
+        "chunks_native": 0,
+        "chunks_fallback": 0,
+        "read_bytes": 0,
+    }
+
+    def visit(span):
+        if span.name == "page_decode":
+            stats["runs_native"] += int(span.attrs.get("runs_native", 0))
+            stats["chunks_runs"] += int(span.attrs.get("chunks_runs", 0))
+            stats["chunks_native"] += int(span.attrs.get("chunks_native", 0))
+            stats["chunks_fallback"] += int(
+                span.attrs.get("chunks_fallback", 0)
+            )
+        elif span.name == "page_read":
+            stats["read_bytes"] += int(span.attrs.get("bytes_read", 0))
+        for child in span.children:
+            visit(child)
+
+    for root in roots:
+        visit(root)
+    return stats
+
+
+def write_encfold_parquet(
+    n_rows: int,
+    path: str,
+    chunk: int = 2_000_000,
+    null_frac: float = 0.03,
+    row_group_size: int = 0,
+) -> None:
+    """The CLUSTERED wide-stream shape for the encoded-fold A/B: the
+    same 50-column schema as write_decode_parquet, but the
+    low-cardinality columns arrive in BURSTS (geometric run lengths,
+    mean ~16) instead of a uniform shuffle — the event-stream /
+    system-of-record layout parquet's RLE hybrid exists for, where a
+    device emits the same status/price-bucket/partition-key for many
+    consecutive rows. On this shape the dictionary-index streams
+    actually run-length compress, so the run-fold kernels do O(runs)
+    work where row expansion does O(rows). The uniform-shuffle worst
+    case (runs of length 1, where folding is pure overhead) keeps its
+    bit-identity pinned by the fuzz differentials; the planner's
+    benefit gate is about consumers, not run shape, so that shape
+    belongs to a falloff study, not this headline."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    writer = None
+    done = 0
+    seed = 0
+    while done < n_rows:
+        rows = min(chunk, n_rows - done)
+        rng = np.random.default_rng(seed)
+
+        def nullify(values):
+            return pa.array(values, mask=rng.random(rows) < null_frac)
+
+        def bursts(draw):
+            """Clustered value stream: geometric-length runs (mean 16)
+            of values drawn by `draw(k)`."""
+            n_blocks = max(1, rows // 8)
+            lens = rng.geometric(1.0 / 16.0, n_blocks)
+            while int(lens.sum()) < rows:
+                lens = np.concatenate(
+                    [lens, rng.geometric(1.0 / 16.0, n_blocks)]
+                )
+            return np.repeat(draw(len(lens)), lens)[:rows]
+
+        data = {}
+        f00 = rng.lognormal(2.0, 1.0, rows)
+        f00[rng.random(rows) < 0.03] = np.nan
+        data["f00"] = nullify(f00)
+        for i in range(1, 20):
+            r = (200, 1_000, 2_000, 10_000)[i % 4]
+            data[f"f{i:02d}"] = nullify(
+                bursts(lambda k, r=r: rng.integers(0, r, k) / 100.0)
+            )
+        for i in range(10):
+            hi = 100 * (i + 1) if i < 6 else 50_000
+            data[f"i{i:02d}"] = nullify(
+                bursts(lambda k, hi=hi: rng.integers(0, hi, k))
+            )
+        for i in range(5):
+            data[f"b{i}"] = nullify(rng.random(rows) < (0.2 + 0.15 * i))
+        for i in range(10):
+            pool = CATEGORIES[: 3 + i]
+            data[f"s{i:02d}"] = nullify(pool[rng.integers(0, len(pool), rows)])
+        for i in range(5):
+            pool = np.array(
+                [str(v) for v in rng.integers(0, 2000 * (i + 1), 4096)],
+                dtype=object,
+            )
+            data[f"c{i}"] = nullify(pool[rng.integers(0, len(pool), rows)])
+        at = pa.table(data)
+        if writer is None:
+            writer = pq.ParquetWriter(path, at.schema)
+        writer.write_table(at, row_group_size=row_group_size or None)
+        done += rows
+        seed += 1
+    if writer is not None:
+        writer.close()
+
+
+def run_encfold_bench(n_rows: int) -> None:
+    """BENCH_MODE=encfold: A/B the encoded-data fold (ISSUE 20) on the
+    low-cardinality half of the decode bench's 50-column wide-stream
+    shape. DEEQU_TPU_ENCODED_FOLD=0 expands every planner-approved
+    chunk to row width (values + validity mask) before folding; =1
+    decodes the same chunks to coalesced (run_len, dict_code) streams
+    plus definition-level runs, folds moments as Σ(run_len × value),
+    rolls dictionary codes up into the sketch families once per chunk,
+    and takes null counts straight from the def-runs — no materialized
+    rows, no validity mask. Both sides run the native page reader, so
+    the delta isolates run-folding itself. Same discipline as the
+    decode/wire/reader A/Bs: a traced warm-up (jit + the planner's
+    encoded-fold verdict), one traced WARM pass per side for
+    decode-stage busy seconds (traced passes are never the timed
+    ones), then two warm untraced timed passes. The headline is the
+    decode-STAGE busy time: run decoding does O(runs) work where row
+    expansion does O(rows), so rows/s scales with ENCODED bytes, not
+    logical rows. Aborts on any metric mismatch or plan/runtime drift.
+    Refreshes BENCH_ENCFOLD.json (round/config preserved)."""
+    import pyarrow.parquet as pq
+
+    from deequ_tpu import observe
+    from deequ_tpu.data.table import Table
+    from deequ_tpu.ops.fused import FusedScanPass
+
+    path = os.environ.get("BENCH_PARQUET", "/tmp/bench_encfold.parquet")
+    rg_rows = 1 << 18
+    t_gen = time.perf_counter()
+    if not (
+        os.path.exists(path) and pq.ParquetFile(path).metadata.num_rows == n_rows
+    ):
+        write_encfold_parquet(n_rows, path, row_group_size=rg_rows)
+    gen_s = time.perf_counter() - t_gen
+
+    analyzers = encfold_analyzers()
+    workers_n = min(os.cpu_count() or 1, 4)
+    os.environ["DEEQU_TPU_DECODE_WORKERS"] = str(workers_n)
+    os.environ["DEEQU_TPU_NATIVE_READER"] = "1"
+    # host fold: a device-packed column would expand its stub every
+    # batch, so the classifier excludes it by design — the encoded
+    # fold is a host-side decode optimization
+    os.environ["DEEQU_TPU_PLACEMENT"] = "host"
+
+    def run_once():
+        snapshot = {}
+        for r in FusedScanPass(analyzers).run(
+            Table.scan_parquet(path, batch_rows=1 << 20)
+        ):
+            value = r.analyzer.compute_metric_from(r.state_or_raise()).value
+            v = (
+                value.get()
+                if value.is_success
+                else type(value.exception).__name__
+            )
+            if isinstance(v, float) and v != v:
+                v = "nan"  # nan != nan would defeat the A/B comparison
+            snapshot[repr(r.analyzer)] = v
+        return snapshot
+
+    # warm-up FIRST (traced, fold ON): compiles every program, pays the
+    # one-time imports, and records the planner's encoded-fold verdict
+    os.environ["DEEQU_TPU_ENCODED_FOLD"] = "1"
+    with observe.tracing() as tracer_warm:
+        warm_snapshot = run_once()
+    cols_enc = int(tracer_warm.counters.get("encfold_cols", 0))
+    cols_total = int(tracer_warm.counters.get("encfold_cols_total", 0))
+    if cols_enc == 0:
+        raise SystemExit(
+            "encfold A/B: the planner approved no column on the "
+            "low-cardinality shape — the on side would measure nothing"
+        )
+
+    # decode-stage busy seconds per side from one traced WARM pass each
+    os.environ["DEEQU_TPU_ENCODED_FOLD"] = "0"
+    with observe.tracing() as tracer_off:
+        off_traced_snapshot = run_once()
+    os.environ["DEEQU_TPU_ENCODED_FOLD"] = "1"
+    with observe.tracing() as tracer_on:
+        on_traced_snapshot = run_once()
+    stage_s_off = _decode_stage_busy_s(tracer_off.roots)
+    stage_s_on = _decode_stage_busy_s(tracer_on.roots)
+    occupancy_off = _occupancy_rows(tracer_off.roots)
+    occupancy_on = _occupancy_rows(tracer_on.roots)
+    runtime_stats = _encfold_span_stats(tracer_on.roots)
+    off_stats = _encfold_span_stats(tracer_off.roots)
+    counters = dict(tracer_on.counters)
+    if runtime_stats["runs_native"] != int(counters.get("encfold_runs", 0)):
+        raise SystemExit(
+            "encfold A/B: per-span run counts drifted from the traced "
+            f"total ({runtime_stats['runs_native']} vs "
+            f"{counters.get('encfold_runs', 0)})"
+        )
+    if runtime_stats["chunks_fallback"] > 0:
+        raise SystemExit(
+            "encfold A/B: a chunk of this all-dictionary shape fell "
+            f"back to row width at decode "
+            f"({runtime_stats['chunks_fallback']} chunks) — the on "
+            "side's numbers would charge the row path to the fold"
+        )
+    if int(counters.get("encfold_chunks", 0)) == 0:
+        raise SystemExit(
+            "encfold A/B: no chunk reached the run decoder despite "
+            f"{cols_enc} approved column(s)"
+        )
+
+    # warm-jit warm-IO wall times, untraced: the fold is decode-bound,
+    # not IO-bound — cold-IO timing belongs to the reader A/B
+    os.environ["DEEQU_TPU_ENCODED_FOLD"] = "0"
+    t0 = time.perf_counter()
+    off_snapshot = run_once()
+    off_s = time.perf_counter() - t0
+
+    os.environ["DEEQU_TPU_ENCODED_FOLD"] = "1"
+    t0 = time.perf_counter()
+    on_snapshot = run_once()
+    on_s = time.perf_counter() - t0
+
+    if not (
+        warm_snapshot == off_traced_snapshot == on_traced_snapshot
+        == off_snapshot == on_snapshot
+    ):
+        raise SystemExit(
+            "encfold A/B: metric mismatch between the encoded-fold and "
+            f"row-width sides\noff: {off_snapshot}\non:  {on_snapshot}"
+        )
+
+    reduction = (
+        100.0 * (stage_s_off - stage_s_on) / stage_s_off
+        if stage_s_off > 0
+        else 0.0
+    )
+    speedup_x = stage_s_off / stage_s_on if stage_s_on > 0 else 0.0
+    runs = int(counters.get("encfold_runs", 0))
+    values = int(counters.get("encfold_values", 0))
+    rec = {
+        "metric": "encfold_rows_per_sec_per_chip",
+        "value": round(n_rows / on_s, 1),
+        "unit": "rows/s",
+        "rows": n_rows,
+        "columns": cols_total,
+        "encfold_ab": {
+            "off_s": round(off_s, 2),
+            "on_s": round(on_s, 2),
+            "speedup_pct": round(100.0 * (off_s - on_s) / off_s, 1),
+            "decode_stage_s_off": round(stage_s_off, 2),
+            "decode_stage_s_on": round(stage_s_on, 2),
+            "decode_stage_reduction_pct": round(reduction, 1),
+            "decode_stage_speedup_x": round(speedup_x, 2),
+            "occupancy_off": occupancy_off,
+            "occupancy_on": occupancy_on,
+            "cols_encfold": cols_enc,
+            "cols_total": cols_total,
+            "chunks_runs": runtime_stats["chunks_runs"],
+            "chunks_row_off": off_stats["chunks_native"],
+            "runs": runs,
+            "values": values,
+            "run_ratio": round(values / runs, 2) if runs else 0.0,
+            "codes_folded": int(counters.get("encfold_codes_folded", 0)),
+            "bytes_saved_mb": round(
+                int(counters.get("encfold_bytes_saved", 0)) / 1e6, 1
+            ),
+            "encoded_read_mb": round(runtime_stats["read_bytes"] / 1e6, 1),
+            "logical_mb": round(n_rows * 8 * cols_total / 1e6, 1),
+            "workers_n": workers_n,
+            "bit_identical": True,
+            "passes": (
+                "traced warm-up (on) for the encoded-fold verdict + one "
+                "traced warm pass per side for decode-stage busy "
+                "seconds; both timed passes are warm-jit, untraced"
+            ),
+        },
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "BENCH_ENCFOLD.json")
+    try:
+        with open(out_path) as fh:
+            old = json.load(fh)
+        for key in ("round", "config"):
+            if key in old and key not in rec:
+                rec[key] = old[key]
+    except Exception:  # noqa: BLE001 - first write: no fields to carry
+        pass
+    with open(out_path, "w") as fh:
+        json.dump(rec, fh)
+        fh.write("\n")
+    print(
+        f"# bench: encfold A/B off={off_s:.2f}s on={on_s:.2f}s "
+        f"(+{100.0 * (off_s - on_s) / off_s:.1f}%), decode stage "
+        f"{stage_s_off:.2f}s -> {stage_s_on:.2f}s "
+        f"({speedup_x:.2f}x, -{reduction:.1f}%), "
+        f"{cols_enc}/{cols_total} cols folded, "
+        f"{values}/{runs} values/runs "
+        f"({(values / runs if runs else 0):.1f}x), "
+        f"gen={gen_s:.1f}s",
+        file=sys.stderr,
+    )
+    print(json.dumps(rec))
+
+
 def write_incremental_dataset(n_rows: int, n_parts: int, dir_path: str) -> None:
     """A partitioned dataset (one parquet file per partition) with
     deterministic per-partition contents: two doubles (one with NaN
@@ -2413,6 +2761,11 @@ def main() -> None:
     if mode == "reader":
         # self-contained A/B with its own JSON record and artifact
         run_reader_bench(n_rows)
+        return
+
+    if mode == "encfold":
+        # self-contained A/B with its own JSON record and artifact
+        run_encfold_bench(n_rows)
         return
 
     if mode == "forensics":

@@ -1,0 +1,401 @@
+"""Encoded-fold batch layer: analyzer families folded over run streams.
+
+data/native_reader.py's decode_chunk_runs turns a planner-approved
+dictionary-coded column chunk into RunChunk streams — coalesced
+(run_length, dict_code) value runs plus definition-level runs — without
+ever expanding to row width. This module is the bridge from those
+streams to the scan's per-batch memo keys:
+
+- `build_payload` slices a batch's row range out of the run streams
+  (cumulative-sum rank lookups pick the boundary runs; the
+  encfold_code_counts C kernel folds the interior) and rolls dictionary
+  codes up to engine values ONCE per batch, yielding the batch's exact
+  value multiset plus its definition-run null count.
+- `publish_memos` derives the family memos (fused moments, decimated
+  quantile sample, HLL++ registers) from that multiset through
+  ops/counts_family.family_from_value_counts — the SAME derivation the
+  row path's counts fast path uses, which is what makes encoded-fold
+  results bit-identical to the row path by construction rather than by
+  testing alone.
+- `EncFoldStub` stands in for the row-width Column; an unplanned
+  consumer (forensics capture, a declined publication) triggers lazy
+  expansion through the row path's own read_chunk/assemble_column
+  machinery, so fallback is bit-identical too.
+
+Publication is always optional: declining (too many distinct values, a
+corrupt run slice, an unprovable exact sum) just leaves the memos unset
+and the stub expands — fail closed to the row-width path, never to
+wrong values.
+
+tools/lint.py's READER rule covers this module: the encoded-fold path
+owns the bytes end to end and must never lean on pyarrow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deequ_tpu.data import native_reader as nr
+from deequ_tpu.data.table import Column
+from deequ_tpu.ops import native
+
+__all__ = [
+    "DISTINCT_PUBLISH_CAP",
+    "EncFoldColSpec",
+    "EncFoldPayload",
+    "EncFoldStub",
+    "build_payload",
+    "publish_memos",
+]
+
+#: distinct-value ceiling for publishing SKETCH-family memos from a
+#: batch payload: below it the row path's counts fast path provably
+#: fires on the same batch (its 4096-row sample pre-check can never see
+#: more distincts than the whole batch holds), so both paths derive the
+#: family from the same multiset through the same counts_family code —
+#: bit-identical. Above it the row path might run the select kernel
+#: instead, so publication declines and the stub expands.
+DISTINCT_PUBLISH_CAP = 4000
+
+_WHERE_ALL = "where:<all>"
+
+
+@dataclass(frozen=True)
+class EncFoldColSpec:
+    """The planner's per-column encoded-fold verdict
+    (ops/fused.py:classify_encfold_columns), shipped to the source so
+    decode and publication stay inside the statically proven scope."""
+
+    column: str
+    token: str
+    #: "i64" | "f64": counts-family kind of the engine representation
+    kind: str
+    #: True when the planner proved the moments memo may publish WITHOUT
+    #: a sketch job on the column: integer engine values, footer bounds
+    #: inside +-2^31 (the sequential kernel's long-double sum is then
+    #: exact, equal to the counts path's exact integer sum), and no
+    #: StandardDeviation consumer (its m2 needs the kernel's stream
+    #: order). Re-checked at runtime against the actual dictionary.
+    publish_moments: bool
+
+
+@dataclass
+class EncFoldPayload:
+    """One (column, batch) value multiset folded from run streams:
+    distinct engine values with occurrence counts (NaN dictionary
+    entries folded into the null count, exactly like decode.c folds NaN
+    rows into the validity mask), plus the batch row/null totals."""
+
+    spec: EncFoldColSpec
+    values: np.ndarray  # distinct engine values (int64 or float64)
+    counts: np.ndarray  # int64 occurrence counts, same length
+    n_rows: int
+    null_count: int
+    runs: int  # sliced runs folded (telemetry: run_ratio)
+    codes_folded: int  # distinct dictionary codes rolled up
+
+
+def _cums(rc: nr.RunChunk):
+    """Cached cumulative sums for rank lookups into one RunChunk:
+    (def_cum rows, null_cum nulls, run_cum non-null values)."""
+    cached = getattr(rc, "_encfold_cums", None)
+    if cached is None:
+        def_cum = np.cumsum(rc.def_len)
+        null_cum = np.cumsum(rc.def_len * (rc.def_val == 0))
+        run_cum = np.cumsum(rc.run_len)
+        cached = (def_cum, null_cum, run_cum)
+        rc._encfold_cums = cached
+    return cached
+
+
+def _nulls_before(rc: nr.RunChunk, row: int) -> int:
+    """Nulls among the chunk's first `row` rows, from definition-level
+    runs alone — no materialized validity mask."""
+    if row <= 0:
+        return 0
+    def_cum, null_cum, _ = _cums(rc)
+    i = int(np.searchsorted(def_cum, row, side="left"))
+    prev_rows = int(def_cum[i - 1]) if i > 0 else 0
+    prev_nulls = int(null_cum[i - 1]) if i > 0 else 0
+    extra = (row - prev_rows) if rc.def_val[i] == 0 else 0
+    return prev_nulls + extra
+
+
+def _slice_code_counts(
+    rc: nr.RunChunk, lo: int, hi: int
+) -> Optional[Tuple[np.ndarray, int, int]]:
+    """Fold chunk rows [lo, hi) into per-code occurrence counts:
+    (counts[dict_count], nulls_in_range, runs_folded). The boundary runs
+    are clipped by rank lookup; the interior folds through the C kernel.
+    None when a run is corrupt — the caller fails closed."""
+    nulls_lo = _nulls_before(rc, lo)
+    nulls_hi = _nulls_before(rc, hi)
+    nn_lo = lo - nulls_lo
+    nn_hi = hi - nulls_hi
+    nulls_in_range = (hi - lo) - (nn_hi - nn_lo)
+    if nn_hi <= nn_lo:
+        return np.zeros(rc.dict_count, dtype=np.int64), nulls_in_range, 0
+    _, _, run_cum = _cums(rc)
+    i0 = int(np.searchsorted(run_cum, nn_lo, side="right"))
+    i1 = int(np.searchsorted(run_cum, nn_hi - 1, side="right"))
+    run_len = rc.run_len[i0 : i1 + 1].astype(np.int64, copy=True)
+    run_code = rc.run_code[i0 : i1 + 1]
+    prev = int(run_cum[i0 - 1]) if i0 > 0 else 0
+    run_len[0] -= nn_lo - prev
+    run_len[-1] -= int(run_cum[i1]) - nn_hi
+    counts = native.encfold_code_counts(run_len, run_code, rc.dict_count)
+    if counts is None:
+        return None
+    return counts, nulls_in_range, len(run_len)
+
+
+def build_payload(
+    spec: EncFoldColSpec,
+    segments: List[nr.RunChunk],
+    start: int,
+    stop: int,
+) -> Optional[EncFoldPayload]:
+    """Fold rows [start, stop) of the run segments into the batch's
+    value multiset. One code->value rollup per chunk at the end — the
+    dictionary is the only per-value work; everything else is per-run.
+    Returns None when any slice fails validation or the multiset
+    disagrees with the definition-run null count (fail closed: the memo
+    publication is skipped and the stub expands to the row path)."""
+    parts_v: List[np.ndarray] = []
+    parts_c: List[np.ndarray] = []
+    null_count = 0
+    runs = 0
+    for rc, lo, hi in nr._segment_overlaps(segments, start, stop):
+        sliced = _slice_code_counts(rc, lo, hi)
+        if sliced is None:
+            return None
+        counts, seg_nulls, seg_runs = sliced
+        null_count += seg_nulls
+        runs += seg_runs
+        nz = np.flatnonzero(counts)
+        if len(nz):
+            parts_v.append(rc.dict_values[nz])
+            parts_c.append(counts[nz])
+    n_rows = stop - start
+    if parts_v:
+        allv = np.concatenate(parts_v)
+        allc = np.concatenate(parts_c)
+        # merge by bit pattern: chunks have independent dictionaries, and
+        # a wrap-narrowed dictionary can map two codes to one engine
+        # value even within a single chunk
+        keys, inverse = np.unique(allv.view(np.uint64), return_inverse=True)
+        counts = np.zeros(len(keys), dtype=np.int64)
+        np.add.at(counts, inverse, allc)
+        values = keys.view(allv.dtype)
+        if spec.kind == "f64":
+            nan = np.isnan(values)
+            if nan.any():
+                # NaN rows are nulls in the engine representation
+                # (decode.c folds them into the mask); the multiset must
+                # match what the row path's valid mask admits
+                null_count += int(counts[nan].sum())
+                values = values[~nan]
+                counts = counts[~nan]
+    else:
+        values = np.zeros(
+            0, dtype=np.float64 if spec.kind == "f64" else np.int64
+        )
+        counts = np.zeros(0, dtype=np.int64)
+    if int(counts.sum()) != n_rows - null_count:
+        return None
+    return EncFoldPayload(
+        spec=spec,
+        values=values,
+        counts=counts,
+        n_rows=n_rows,
+        null_count=null_count,
+        runs=runs,
+        codes_folded=len(values),
+    )
+
+
+def _moments_memo(mom, n_rows: int) -> Dict[str, float]:
+    return {
+        "count": float(mom[0]),
+        "sum": float(mom[1]),
+        "min": float(mom[2]),
+        "max": float(mom[3]),
+        "m2": float(mom[4]),
+        "n_where": float(mom[5]),
+        "n_rows": float(n_rows),
+    }
+
+
+def publish_memos(
+    built: Dict,
+    payloads: Dict[str, EncFoldPayload],
+    planned,
+) -> int:
+    """Publish family memos derived from batch payloads, BEFORE the
+    family-kernel loop runs: a published qkey makes
+    _precompute_family_kernels skip the column's select job, and the
+    assisted/merge members answer from the memos without ever
+    materializing the column. Derivations go through
+    counts_family.family_from_value_counts — shared with the row path's
+    counts fast path — and publication declines whenever bit-identity
+    with the row path is not PROVEN for this batch (too many distincts
+    for the row-side shortcut to be guaranteed, unprovable exact sum).
+    Returns the number of columns whose memos were published."""
+    from deequ_tpu.ops import counts_family
+
+    published = set()
+    covered = set()
+    for pj in planned:
+        payload = payloads.get(pj.column)
+        if payload is None or pj.where is not None:
+            continue
+        covered.add(pj.column)
+        if pj.qkey in built:
+            continue
+        if len(payload.values) > DISTINCT_PUBLISH_CAP:
+            continue
+        mom, sample, n_valid, level, regs = (
+            counts_family.family_from_value_counts(
+                payload.values,
+                payload.counts,
+                payload.spec.kind,
+                pj.cap,
+                payload.n_rows,
+                pj.want_regs,
+            )
+        )
+        built[pj.qkey] = {
+            "sample": sample,
+            "n": np.asarray([n_valid], dtype=np.float64),
+            "level": np.asarray([level], dtype=np.int32),
+        }
+        if regs is not None:
+            built[pj.rkey] = regs
+        if pj.mkey not in built:
+            built[pj.mkey] = _moments_memo(mom, payload.n_rows)
+        published.add(pj.column)
+    for column, payload in payloads.items():
+        # moments-only publication for columns without a sketch job: the
+        # row path would run the sequential moments kernel, so the
+        # planner's exact-sum proof is re-checked against the actual
+        # values (|v| < 2^31 keeps the kernel's long-double stream sum
+        # exact and equal to the counts path's exact integer sum)
+        if column in covered or not payload.spec.publish_moments:
+            continue
+        if payload.spec.kind != "i64":
+            continue
+        if len(payload.values) and (
+            int(payload.values.min()) <= -(1 << 31)
+            or int(payload.values.max()) >= (1 << 31)
+        ):
+            continue
+        # the row path's int64 moments fallback sums in PAIRWISE float64
+        # (np.sum): every partial sum is a subset sum of the values, so
+        # Σ|v| < 2^53 makes every partial an exact integer and the
+        # pairwise total equal to this path's exact integer sum. The
+        # int64 dot cannot wrap: |v| < 2^31 and n_rows < 2^32 bound it
+        # under 2^63.
+        if payload.n_rows >= (1 << 32):
+            continue
+        if len(payload.values) and int(
+            np.dot(payload.counts, np.abs(payload.values))
+        ) >= (1 << 53):
+            continue
+        mkey = f"__moments:{column}:{_WHERE_ALL}"
+        if mkey in built:
+            continue
+        mom, _sample, _n_valid, _level, _regs = (
+            counts_family.family_from_value_counts(
+                payload.values,
+                payload.counts,
+                payload.spec.kind,
+                4096,
+                payload.n_rows,
+                False,
+            )
+        )
+        built[mkey] = _moments_memo(mom, payload.n_rows)
+        published.add(column)
+    return len(published)
+
+
+class EncFoldStub(Column):
+    """Stand-in Column for an encoded-fold column: consumers that the
+    planner proved memo-served never touch it; an unplanned consumer (a
+    declined publication, forensics capture) triggers lazy expansion of
+    the retained RunChunks through the row path's own
+    read_chunk/assemble_column machinery — bit-identical by
+    construction, same contract as NativeWireStub."""
+
+    def __init__(self, name, ctype, token, run_segments, start, stop):
+        self._enc_n = int(stop - start)
+        self._enc_token = token
+        self._enc_segments = run_segments
+        self._enc_start = int(start)
+        self._enc_stop = int(stop)
+        super().__init__(name, ctype, self._enc_rebuild_values, None)
+
+    def __len__(self) -> int:
+        return self._enc_n
+
+    def _enc_rebuild(self) -> Column:
+        segs = []
+        for rc in self._enc_segments:
+            dc = getattr(rc, "_encfold_expanded", None)
+            if dc is None:
+                dc = nr.expand_runs(rc)
+                if dc is None:
+                    raise RuntimeError(
+                        "native library became unavailable during "
+                        f"encoded-fold expansion of column {self.name!r}"
+                    )
+                rc._encfold_expanded = dc
+            segs.append(dc)
+        return nr.assemble_column(
+            self.name,
+            self._enc_token,
+            segs,
+            self._enc_start,
+            self._enc_stop,
+            {},
+        )
+
+    def _enc_rebuild_values(self):
+        col = self._enc_rebuild()
+        if self._valid_arr is None:
+            self._valid_arr = np.asarray(col.valid)
+        return col.values
+
+    def _enc_defs_valid(self) -> Optional[np.ndarray]:
+        """Validity straight from the definition-level runs, with no
+        value expansion — exact for integer columns; float columns with
+        a NaN dictionary entry must expand instead (the row path folds
+        NaN rows into the mask, which def levels alone cannot see)."""
+        for rc in self._enc_segments:
+            if rc.kind == "f64" and np.isnan(rc.dict_values).any():
+                return None
+        out = np.empty(self._enc_n, dtype=np.bool_)
+        pos = 0
+        for rc, lo, hi in nr._segment_overlaps(
+            self._enc_segments, self._enc_start, self._enc_stop
+        ):
+            mask = np.repeat(rc.def_val.astype(np.bool_), rc.def_len)
+            out[pos : pos + (hi - lo)] = mask[lo:hi]
+            pos += hi - lo
+        return out
+
+    @property
+    def valid(self):
+        if self._valid_arr is None:
+            mask = self._enc_defs_valid()
+            if mask is None:
+                mask = np.asarray(self._enc_rebuild().valid)
+            self._valid_arr = mask
+        return self._valid_arr
+
+    @valid.setter
+    def valid(self, value):
+        self._valid_arr = value

@@ -36,9 +36,12 @@ __all__ = [
     "ChunkMeta",
     "DecodedChunk",
     "NativeWireStub",
+    "RunChunk",
     "assemble_column",
     "assemble_wire_column",
     "decode_chunk",
+    "decode_chunk_runs",
+    "expand_runs",
     "fadvise_chunk",
     "fetch_chunk",
 ]
@@ -116,6 +119,146 @@ def decode_chunk(raw: np.ndarray, meta: ChunkMeta) -> Optional[DecodedChunk]:
     )
     res = native.read_chunk(
         raw, meta.phys, meta.codec, itemsize, meta.max_def, nv, out_values, out_validity
+    )
+    if res is None:
+        return None
+    null_count, pages, uncompressed = res
+    return DecodedChunk(
+        token=meta.token,
+        values=out_values,
+        validity=out_validity if null_count else None,
+        null_count=null_count,
+        num_values=nv,
+        pages=pages,
+        uncompressed_bytes=uncompressed,
+    )
+
+
+#: tokens the encoded-run mode handles: numeric columns whose dictionary
+#: rolls up to the engine's int64/float64 representation. bool pages are
+#: not dictionary-coded and uint64 has no exact engine widening.
+ENCFOLD_TOKENS = frozenset(
+    {
+        "double",
+        "float",
+        "int8",
+        "int16",
+        "int32",
+        "int64",
+        "uint8",
+        "uint16",
+        "uint32",
+    }
+)
+
+
+@dataclass
+class RunChunk:
+    """One column chunk decoded to encoded-run streams instead of row
+    width: coalesced (run_length, dict_code) value runs, coalesced
+    (run_length, present) definition-level runs, and the dictionary
+    rolled up to engine representation. `raw` retains the compressed
+    chunk bytes so an unplanned consumer can still expand to the
+    row-width path lazily (expand_runs) — the expansion goes through the
+    SAME read_chunk/assemble_column machinery the row path uses, which
+    is what keeps the fallback bit-identical by construction."""
+
+    meta: ChunkMeta
+    raw: np.ndarray  # compressed chunk bytes (kept for lazy expansion)
+    kind: str  # "i64" | "f64": engine representation of dict_values
+    dict_values: np.ndarray  # dictionary in engine repr (int64/float64)
+    run_len: np.ndarray  # int64 coalesced non-null value runs
+    run_code: np.ndarray  # uint32 dict codes, validated < dict_count
+    def_len: np.ndarray  # int64 coalesced definition-level runs
+    def_val: np.ndarray  # uint8, 0 = null rows / 1 = present rows
+    null_count: int
+    num_values: int
+    pages: int
+    uncompressed_bytes: int
+
+    @property
+    def dict_count(self) -> int:
+        return len(self.dict_values)
+
+
+def _dict_to_engine(draw: np.ndarray, phys: int, token: str):
+    """Dictionary page values (physical layout) -> engine representation
+    (int64/float64) plus the counts-family kind, replicating the exact
+    widening chain the row path applies per value (store_cast's
+    truncating narrow to the backing dtype, then decode.c's widen): both
+    are numpy astype C-casts, so a wrap-narrowed dictionary entry rolls
+    up to the same engine value its row-expanded copies would."""
+    phys_np = {1: "<i4", 2: "<i8", 4: "<f4", 5: "<f8"}[int(phys)]
+    entries = draw.view(np.dtype(phys_np))
+    if token in ("double", "float"):
+        return entries.astype(np.float64), "f64"
+    backing = native.READER_TOKENS[token][1]
+    return entries.astype(np.dtype(backing)).astype(np.int64), "i64"
+
+
+def decode_chunk_runs(raw: np.ndarray, meta: ChunkMeta) -> Optional[RunChunk]:
+    """Decode one raw chunk byte range into encoded-run streams through
+    pq_decode_chunk_runs. Returns None on any decode error — a PLAIN
+    data page (dictionary fallback mid-chunk), oversized dictionary,
+    corrupt run structure — and on the decode.runs chaos directive; the
+    caller decodes the chunk at row width instead, so a corrupt run can
+    fail closed but never fold into wrong values."""
+    if faults.fault_point("decode.runs") == "fail":
+        return None
+    if meta.token not in ENCFOLD_TOKENS:
+        return None
+    res = native.read_chunk_runs(
+        raw, meta.phys, meta.codec, meta.max_def, meta.num_values
+    )
+    if res is None:
+        return None
+    draw, run_len, run_code, def_len, def_val, nulls, pages, unc, dcount = res
+    # cross-check the def-run fold against the page-loop null count and
+    # the value-run total against the non-null count: any disagreement
+    # means a corrupt stream slipped the C validation — fail closed
+    def_nulls = native.encfold_def_nulls(def_len, def_val, meta.num_values)
+    if def_nulls is None or def_nulls != nulls:
+        return None
+    if int(run_len.sum()) != meta.num_values - nulls:
+        return None
+    dict_values, kind = _dict_to_engine(draw, meta.phys, meta.token)
+    return RunChunk(
+        meta=meta,
+        raw=raw,
+        kind=kind,
+        dict_values=dict_values,
+        run_len=run_len,
+        run_code=run_code,
+        def_len=def_len,
+        def_val=def_val,
+        null_count=nulls,
+        num_values=meta.num_values,
+        pages=pages,
+        uncompressed_bytes=unc,
+    )
+
+
+def expand_runs(rc: RunChunk) -> Optional[DecodedChunk]:
+    """Row-width expansion of a RunChunk from its retained raw bytes,
+    for unplanned consumers (decode_chunk minus the decode.chunk chaos
+    gate: the bytes already run-decoded cleanly this session, so the
+    expansion seam is internal, not an injection point). Returns None
+    only if the native library became unavailable mid-session."""
+    meta = rc.meta
+    nv = meta.num_values
+    out_values = np.zeros(nv, dtype=np.dtype(meta.dtype))
+    out_validity = (
+        np.zeros((nv + 7) // 8, dtype=np.uint8) if meta.max_def else None
+    )
+    res = native.read_chunk(
+        rc.raw,
+        meta.phys,
+        meta.codec,
+        out_values.dtype.itemsize,
+        meta.max_def,
+        nv,
+        out_values,
+        out_validity,
     )
     if res is None:
         return None

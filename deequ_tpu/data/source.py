@@ -311,6 +311,7 @@ class ParquetSource(DataSource):
         decode_fastpath: Optional[Sequence[str]] = None,
         wire_fusion=None,
         native_reader: Optional[Sequence[str]] = None,
+        encoded_fold=None,
     ):
         import pyarrow.parquet as pq
 
@@ -340,6 +341,11 @@ class ParquetSource(DataSource):
         self.native_reader = (
             frozenset(native_reader) if native_reader else None
         )
+        # per-column encoded-fold specs (data/encfold.EncFoldColSpec)
+        # the planner proved run-foldable (classify_encfold_columns):
+        # those chunks decode to (run, code) streams and fold family
+        # state over runs instead of rows. None/empty = row-width path.
+        self.encoded_fold = dict(encoded_fold) if encoded_fold else None
         pf = pq.ParquetFile(path)
         meta = pf.metadata
         if self.prune_groups:
@@ -380,6 +386,7 @@ class ParquetSource(DataSource):
             decode_fastpath=self.decode_fastpath,
             wire_fusion=self.wire_fusion,
             native_reader=self.native_reader,
+            encoded_fold=self.encoded_fold,
         )
 
     def with_prune(self, skip) -> "ParquetSource":
@@ -400,6 +407,7 @@ class ParquetSource(DataSource):
             decode_fastpath=self.decode_fastpath,
             wire_fusion=self.wire_fusion,
             native_reader=self.native_reader,
+            encoded_fold=self.encoded_fold,
         )
 
     def with_decode_fastpath(self, names) -> "ParquetSource":
@@ -418,6 +426,7 @@ class ParquetSource(DataSource):
             decode_fastpath=names,
             wire_fusion=self.wire_fusion,
             native_reader=self.native_reader,
+            encoded_fold=self.encoded_fold,
         )
 
     def with_wire_fusion(self, plan) -> "ParquetSource":
@@ -435,6 +444,7 @@ class ParquetSource(DataSource):
             decode_fastpath=self.decode_fastpath,
             wire_fusion=plan,
             native_reader=self.native_reader,
+            encoded_fold=self.encoded_fold,
         )
 
     def with_native_reader(self, names) -> "ParquetSource":
@@ -454,6 +464,28 @@ class ParquetSource(DataSource):
             decode_fastpath=self.decode_fastpath,
             wire_fusion=self.wire_fusion,
             native_reader=names,
+            encoded_fold=self.encoded_fold,
+        )
+
+    def with_encoded_fold(self, specs) -> "ParquetSource":
+        """Encoded-fold view: `specs` maps columns the planner proved
+        run-foldable (ops/fused.py:classify_encfold_columns) to their
+        EncFoldColSpec. Encoded fold rides on the native reader
+        (enc ⊆ reader by planner contract) and fails closed per chunk to
+        the row-width decode, so this composes freely with the other
+        with_* views."""
+        specs = dict(specs) if specs else None
+        if not specs or specs == self.encoded_fold:
+            return self
+        return ParquetSource(
+            self.path,
+            columns=self.columns,
+            batch_rows=self.batch_rows,
+            prune_groups=self.prune_groups,
+            decode_fastpath=self.decode_fastpath,
+            wire_fusion=self.wire_fusion,
+            native_reader=self.native_reader,
+            encoded_fold=specs,
         )
 
     @property
@@ -524,14 +556,16 @@ class ParquetSource(DataSource):
                     # falls off the native reader, never mis-qualifies.
                     try:
                         se = schema.column(j)
-                        offset = int(chunk.data_page_offset)
-                        if (
-                            chunk.has_dictionary_page
+                        dpo = int(chunk.data_page_offset)
+                        dictpo = (
+                            int(chunk.dictionary_page_offset)
+                            if chunk.has_dictionary_page
                             and chunk.dictionary_page_offset is not None
-                        ):
-                            offset = min(
-                                offset, int(chunk.dictionary_page_offset)
-                            )
+                            else None
+                        )
+                        offset = (
+                            dpo if dictpo is None else min(dpo, dictpo)
+                        )
                         layout = dict(
                             physical_type=str(chunk.physical_type),
                             codec=str(chunk.compression),
@@ -543,6 +577,8 @@ class ParquetSource(DataSource):
                             num_values=int(chunk.num_values),
                             max_def_level=int(se.max_definition_level),
                             max_rep_level=int(se.max_repetition_level),
+                            data_page_offset=dpo,
+                            dictionary_page_offset=dictpo,
                         )
                     except Exception:  # noqa: BLE001 - degrade to unknown
                         layout = {}
@@ -610,6 +646,26 @@ class ParquetSource(DataSource):
             and native.available()
         ):
             return self.native_reader
+        return None
+
+    def _encoded_fold_active(self, native_cols):
+        """The planner-approved encoded-fold spec map restricted to the
+        active native-reader columns, or None when the
+        DEEQU_TPU_ENCODED_FOLD kill switch (or any native-reader gate)
+        turns the run-fold path off — the differential's baseline."""
+        from deequ_tpu.ops import runtime
+
+        if (
+            self.encoded_fold
+            and native_cols
+            and runtime.encoded_fold_enabled()
+        ):
+            specs = {
+                n: s
+                for n, s in self.encoded_fold.items()
+                if n in native_cols
+            }
+            return specs or None
         return None
 
     def _reader_chunk_meta(self, native_cols):
@@ -720,6 +776,7 @@ class ParquetSource(DataSource):
         import pyarrow.parquet as pq
 
         from deequ_tpu.core.controller import retry_call
+        from deequ_tpu.data import encfold as _encfold
         from deequ_tpu.data import native_reader as nr
         from deequ_tpu.observe import heartbeat
         from deequ_tpu.ops import runtime
@@ -732,6 +789,8 @@ class ParquetSource(DataSource):
         if not units:
             return
         native_cols = self._native_reader_active()
+        enc_specs = self._encoded_fold_active(native_cols)
+        ctypes = dict(self._schema_cache)
         metas = self._reader_chunk_meta(native_cols)
         if not metas:
             # nothing on disk qualified (footer changed since planning):
@@ -931,14 +990,29 @@ class ParquetSource(DataSource):
                 ) as sp:
                     segments: dict = {}
                     failed: set = set()
+                    enc_off: set = set()
+                    enc_fallback = 0
                     if raw is not None:
                         for g, m in unit_chunks[i]:
                             data = raw.get((g, m.column))
-                            dec = (
-                                nr.decode_chunk(data, m)
-                                if data is not None
-                                else None
-                            )
+                            dec = None
+                            if data is not None:
+                                if (
+                                    enc_specs
+                                    and m.column in enc_specs
+                                    and m.column not in enc_off
+                                ):
+                                    dec = nr.decode_chunk_runs(data, m)
+                                    if dec is None:
+                                        # fail closed: a chunk the run
+                                        # decoder refuses (corrupt run,
+                                        # plain data page, fault) takes
+                                        # the row-width path — never
+                                        # wrong values
+                                        enc_off.add(m.column)
+                                        enc_fallback += 1
+                                if dec is None:
+                                    dec = nr.decode_chunk(data, m)
                             if dec is None:
                                 failed.add(m.column)
                             else:
@@ -953,6 +1027,47 @@ class ParquetSource(DataSource):
                         for n, segs in segments.items()
                         if n not in failed and len(segs) == len(unit)
                     }
+                    # a column folds over runs only when EVERY chunk
+                    # run-decoded; a mixed column expands its run chunks
+                    # back to row width so the ordinary assemble path
+                    # applies unchanged
+                    run_cols: set = set()
+                    for name in list(covered):
+                        segs = segments[name]
+                        is_run = [
+                            isinstance(s, nr.RunChunk) for s in segs
+                        ]
+                        if all(is_run):
+                            run_cols.add(name)
+                        elif any(is_run):
+                            expanded = []
+                            for s in segs:
+                                if isinstance(s, nr.RunChunk):
+                                    s = nr.expand_runs(s)
+                                if s is None:
+                                    break
+                                expanded.append(s)
+                            if len(expanded) == len(segs):
+                                segments[name] = expanded
+                            else:
+                                covered.discard(name)
+                                failed.add(name)
+                    enc_runs = enc_values = enc_saved = 0
+                    for name in run_cols:
+                        for rc in segments[name]:
+                            enc_runs += len(rc.run_len)
+                            enc_values += rc.num_values
+                            # row-width materialization avoided: the
+                            # row path builds an 8-byte value plus a
+                            # 1-byte mask per row; the runs path keeps
+                            # 12 bytes per run plus the dictionary
+                            enc_saved += max(
+                                0,
+                                9 * rc.num_values
+                                - 12 * len(rc.run_len)
+                                - rc.dict_values.nbytes,
+                            )
+                    enc_codes = 0
                     fb_cols = [n for n in scanned if n not in covered]
                     fb_merged = None
                     if fb_cols:
@@ -989,10 +1104,32 @@ class ParquetSource(DataSource):
                         wire_rows = dict(
                             getattr(fb_table, "wire_rows", None) or {}
                         )
+                        enc_payloads: dict = {}
                         cols = []
                         for name in scanned:
                             if name not in covered:
                                 cols.append(fb_table.column(name))
+                                continue
+                            if name in run_cols:
+                                cols.append(
+                                    _encfold.EncFoldStub(
+                                        name,
+                                        ctypes[name],
+                                        tokens[name],
+                                        segments[name],
+                                        start,
+                                        stop_row,
+                                    )
+                                )
+                                payload = _encfold.build_payload(
+                                    enc_specs[name],
+                                    segments[name],
+                                    start,
+                                    stop_row,
+                                )
+                                if payload is not None:
+                                    enc_payloads[name] = payload
+                                    enc_codes += payload.codes_folded
                                 continue
                             col = None
                             if name in wire_cols:
@@ -1021,6 +1158,8 @@ class ParquetSource(DataSource):
                         table = Table(cols)
                         if wire_rows:
                             table.wire_rows = wire_rows
+                        if enc_payloads:
+                            table.encfold = enc_payloads
                         tables.append(table)
                     if sp:
                         chunks_native = len(unit) * len(covered)
@@ -1030,6 +1169,17 @@ class ParquetSource(DataSource):
                             chunks_fallback=len(unit) * len(scanned)
                             - chunks_native,
                             readahead_hit=bool(readahead_hit),
+                            runs_native=int(enc_runs),
+                            chunks_runs=len(unit) * len(run_cols),
+                        )
+                    if enc_specs and (run_cols or enc_fallback):
+                        runtime.record_encfold(
+                            chunks=len(unit) * len(run_cols),
+                            fallback=enc_fallback,
+                            runs=enc_runs,
+                            values=enc_values,
+                            codes=enc_codes,
+                            bytes_saved=enc_saved,
                         )
                     return tables
 

@@ -134,6 +134,21 @@ class PassCost:
     reader_chunks_native: Optional[int] = None
     reader_fallbacks: Tuple[Tuple[str, str], ...] = ()
     saved_alloc_bytes: Optional[float] = None
+    #: encoded-fold prediction (layered on the native-reader verdict,
+    #: single-engine scans only — the consumer proofs need the live
+    #: analyzer set): columns whose chunks will fold over (run, code)
+    #: streams without row-width materialization / columns scanned /
+    #: per-column fall-off reasons naming the disqualifying codec,
+    #: analyzer family, dtype, or dict-size condition. None =
+    #: encoded-fold planning will not run (knob off, distributed pass,
+    #: no reader verdict).
+    encfold_cols: Optional[int] = None
+    encfold_cols_total: Optional[int] = None
+    #: of encfold_cols: columns whose moments fold as Σ(run_len × value)
+    #: directly over RLE runs (the rest roll dictionary codes up into
+    #: their sketch families)
+    encfold_moment_cols: Optional[int] = None
+    encfold_falloffs: Tuple[Tuple[str, str], ...] = ()
     #: partition-state-cache prediction (partitioned parquet sources
     #: only): partitions in the dataset / partitions whose states will
     #: load from the attached StateRepository instead of scanning / file
@@ -441,6 +456,14 @@ def cost_drift(cost: "PlanCost", trace: Any) -> Dict[str, float]:
             out["drift.reader_chunks_native"] = float(
                 int(trace.counters.get("reader_chunks_native", 0))
                 - scan.reader_chunks_native
+            )
+        if (
+            scan.encfold_cols is not None
+            and "encfold_cols" in trace.counters
+        ):
+            out["drift.encfold_columns"] = float(
+                int(trace.counters.get("encfold_cols", 0))
+                - scan.encfold_cols
             )
         if (
             scan.partitions_cached is not None
@@ -952,6 +975,49 @@ def analyze_plan(
                                 if decoded_rows is not None
                                 else None
                             )
+                            # ---- encoded-fold verdict (layered on the
+                            # reader set, single-engine scans only — the
+                            # consumer proofs need the live analyzers).
+                            # Mirrors plan_decode_fastpath's
+                            # encoded-fold branch: same knob, same
+                            # classifier over the same reader columns,
+                            # same footer replay — so the prediction
+                            # pins to the observed encfold_cols counter
+                            # with zero drift.
+                            if (
+                                not distributed
+                                and r_cols
+                                and runtime.encoded_fold_enabled()
+                            ):
+                                from deequ_tpu.ops.fused import (
+                                    classify_encfold_columns,
+                                )
+
+                                e_specs, e_falloffs = (
+                                    classify_encfold_columns(
+                                        {c: col_types[c] for c in r_cols},
+                                        shareable,
+                                        specs_eff,
+                                        device_keys,
+                                        row_groups,
+                                        skip,
+                                        int_bounds=(
+                                            wire_int_bounds_from_groups(
+                                                row_groups, sorted(r_cols)
+                                            )
+                                        ),
+                                    )
+                                )
+                                scan_pass.encfold_cols = len(e_specs)
+                                scan_pass.encfold_cols_total = dplan.total
+                                scan_pass.encfold_moment_cols = sum(
+                                    1
+                                    for s in e_specs.values()
+                                    if s.publish_moments
+                                )
+                                scan_pass.encfold_falloffs = tuple(
+                                    e_falloffs
+                                )
         cost.passes.append(scan_pass)
 
         if streaming:

@@ -62,6 +62,7 @@ CODES = {
     # windowed metrics / drift (windows/, checks/drift.py)
     "DQ323": "window not resolvable from precomputed segments",
     "DQ324": "drift baseline missing or plan-signature mismatched",
+    "DQ325": "column falls off the encoded (run/dictionary) fold",
 }
 
 

@@ -313,6 +313,26 @@ def cost_diagnostics(
                 )
             )
 
+    # DQ325 — encoded fold: reader columns whose chunks still expand to
+    # row width because a codec property, consumer analyzer, dtype, or
+    # dictionary-size condition keeps the run-fold kernels off. The
+    # reason names the disqualifying property with its class prefix
+    # (codec:/analyzer:/dtype:/dict-size:), so the fix — rewrite the
+    # file with dictionary pages, drop the row-width consumer, or move
+    # the member off the device — is actionable per column.
+    if scan is not None and scan.encfold_falloffs:
+        for col, reason in scan.encfold_falloffs:
+            diags.append(
+                Diagnostic(
+                    "DQ325",
+                    Severity.WARNING,
+                    f"column {col!r} falls off the encoded fold "
+                    f"({reason}): its chunks expand to row width instead "
+                    "of folding over (run, code) streams",
+                    source=col,
+                )
+            )
+
     # DQ318 — a deadline over a source with no partition boundaries:
     # nothing commits to the state repository mid-run, so a deadline
     # trip loses ALL scanned work — the rerun starts from zero instead
@@ -433,6 +453,13 @@ def _render_pass(p: PassCost, idx: int) -> List[str]:
                     "arrow materialization)"
                 )
             lines.append(line)
+        if p.encfold_cols is not None and p.encfold_cols_total is not None:
+            moments = p.encfold_moment_cols or 0
+            lines.append(
+                f"  encoded-fold: {p.encfold_cols}/{p.encfold_cols_total} "
+                f"column(s) (runs={moments}, "
+                f"dict={p.encfold_cols - moments})"
+            )
         for g in p.family_groups:
             tag = "batched" if g.batched else "solo"
             lines.append(

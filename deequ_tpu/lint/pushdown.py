@@ -103,6 +103,12 @@ class ColumnStats:
     num_values: Optional[int] = None
     max_def_level: Optional[int] = None
     max_rep_level: Optional[int] = None
+    #: page-placement fields for the encoded-fold planner verdict
+    #: (ops/fused.py:classify_encfold_columns): a chunk without a
+    #: recorded dictionary page cannot be all-dictionary-coded, so its
+    #: column falls off the run-fold path statically.
+    data_page_offset: Optional[int] = None
+    dictionary_page_offset: Optional[int] = None
 
 
 @dataclass(frozen=True)

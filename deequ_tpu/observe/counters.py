@@ -126,6 +126,48 @@ def record_reader_chunks(native: int, fallback: int, total: int) -> None:
         tracer.count("reader_chunks_total", int(total))
 
 
+def record_encfold_plan(cols: int, total: int) -> None:
+    """Encoded-fold plan outcome of one fused scan: columns the planner
+    proved run-foldable (classify_encfold_columns) vs columns scanned.
+    STATIC, recorded once per scan like record_reader_chunks — the trace
+    side of cost_drift's `drift.encfold_columns` pin."""
+    tracer = spans.current_tracer()
+    if tracer is not None:
+        tracer.count("encfold_cols", int(cols))
+        tracer.count("encfold_cols_total", int(total))
+
+
+def record_encfold(
+    chunks: int,
+    fallback: int,
+    runs: int,
+    values: int,
+    codes: int,
+    bytes_saved: int,
+) -> None:
+    """Encoded-fold outcome of one decode unit (the DYNAMIC half —
+    record_encfold_plan carries the static column verdict): chunks that
+    folded over (run, code) streams, chunks that failed closed to the
+    row-width path, runs vs logical values folded (run_ratio — the
+    compression the fold exploited), distinct dictionary codes rolled up
+    to engine values, and row-width bytes never materialized.
+    Tracer-only; the counters feed the `engine.encfold.*` telemetry
+    series the sentinel watches."""
+    tracer = spans.current_tracer()
+    if tracer is not None:
+        tracer.count("encfold_chunks", int(chunks))
+        if fallback:
+            tracer.count("encfold_chunks_fallback", int(fallback))
+        if runs:
+            tracer.count("encfold_runs", int(runs))
+        if values:
+            tracer.count("encfold_values", int(values))
+        if codes:
+            tracer.count("encfold_codes_folded", int(codes))
+        if bytes_saved:
+            tracer.count("encfold_bytes_saved", int(bytes_saved))
+
+
 def record_retry(attempts: int, recovered: int, exhausted: int) -> None:
     """Transient-IO retry outcome of one readahead fetch operation:
     backoff sleeps taken, whether the operation recovered after >=1

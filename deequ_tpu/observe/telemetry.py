@@ -218,6 +218,34 @@ def engine_metric_record(
             rec.get("engine.counter.reader_chunks_native", 0.0) / reader_total
         )
 
+    # derived: encoded-fold health. run_ratio = logical values folded
+    # per (run, code) entry — the compression the fold exploited (the
+    # sentinel watches it dropping toward 1.0: the data stopped
+    # run-compressing and the fold stopped paying). fallback_ratio =
+    # chunks that failed closed to the row-width path out of planned
+    # run-fold chunks plus fallbacks (watched rising: pages stopped
+    # being all-dictionary at decode). codes_folded / bytes_saved =
+    # dictionary codes rolled up to engine values and row-width bytes
+    # never materialized (watched dropping). Only present when an
+    # encoded-fold chunk actually decoded.
+    enc_chunks = rec.get("engine.counter.encfold_chunks", 0.0)
+    enc_fallback = rec.get("engine.counter.encfold_chunks_fallback", 0.0)
+    if enc_chunks > 0.0 or enc_fallback > 0.0:
+        enc_runs = rec.get("engine.counter.encfold_runs", 0.0)
+        if enc_runs > 0.0:
+            rec["engine.encfold.run_ratio"] = (
+                rec.get("engine.counter.encfold_values", 0.0) / enc_runs
+            )
+        rec["engine.encfold.fallback_ratio"] = enc_fallback / (
+            enc_chunks + enc_fallback
+        )
+        rec["engine.encfold.codes_folded"] = rec.get(
+            "engine.counter.encfold_codes_folded", 0.0
+        )
+        rec["engine.encfold.bytes_saved"] = rec.get(
+            "engine.counter.encfold_bytes_saved", 0.0
+        )
+
     # derived: fraction of dataset partitions whose analyzer states
     # loaded from the persistent state cache instead of scanning — the
     # sentinel watches it for incremental-scan regressions; only present

@@ -231,6 +231,29 @@ def family_from_hash_counts(
     return mom, sample, m, level, regs
 
 
+def family_from_value_counts(
+    values: np.ndarray,
+    counts: np.ndarray,
+    kind: str,
+    cap: int,
+    n_where: int,
+    want_regs: bool,
+):
+    """Derive the select kernel's output tuple from distinct
+    (value, count) pairs in engine representation — int64 values for
+    'i64', float64 for 'f64'. The encoded-fold path lands here after
+    rolling dictionary codes up to values: reinterpreting the values as
+    hash keys makes this literally family_from_hash_counts, so every
+    derivation rule (f64 total order, exact integer sums, level law,
+    distinct-only HLL) is shared with the row path's counts fast path —
+    which is what makes the two paths bit-identical for the same
+    multiset."""
+    values = np.ascontiguousarray(values)
+    return family_from_hash_counts(
+        values.view(np.uint64), counts, kind, cap, n_where, want_regs
+    )
+
+
 def family_from_counts(
     counts: np.ndarray,
     lo: int,

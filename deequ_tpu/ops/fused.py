@@ -749,6 +749,16 @@ class DecodePlan:
     reader_falloffs: Tuple[Tuple[str, str], ...] = ()  # (column, reason)
     reader_groups: int = 0
     reader_planned: bool = False
+    # encoded-fold verdict layered on the reader set: columns whose
+    # every live chunk is provably all-dictionary-coded AND whose every
+    # consumer the run-fold memos can serve (classify_encfold_columns),
+    # the per-column fall-off reasons (EXPLAIN's DQ325), and the
+    # col -> EncFoldColSpec map the source ships to decode_unit.
+    # enc_planned follows reader_planned's record-the-zeros contract.
+    enc_cols: Tuple[str, ...] = ()
+    enc_falloffs: Tuple[Tuple[str, str], ...] = ()  # (column, reason)
+    enc_specs: Any = field(default=None, compare=False)
+    enc_planned: bool = False
 
     @property
     def total(self) -> int:
@@ -910,6 +920,205 @@ def reader_saved_alloc_bytes_per_row(
         _DECODE_TOKEN_BYTES.get(col_types.get(c, ""), 0) + 1
         for c in reader_cols
     )
+
+
+#: analyzer families the encoded-fold planner may serve from run-fold
+#: memos (ops/analyzers answering from the family/moments memo keys):
+#: anything else on the column needs row-width values and falls it off.
+_ENCFOLD_ANALYZERS = frozenset(
+    {
+        "Mean", "Sum", "Minimum", "Maximum", "StandardDeviation",
+        "Completeness", "ApproxQuantile", "ApproxQuantiles",
+        "ApproxCountDistinct",
+    }
+)
+
+#: members whose family job publishes the full sketch memos
+_ENCFOLD_SKETCH = frozenset(
+    {"ApproxQuantile", "ApproxQuantiles", "ApproxCountDistinct"}
+)
+
+#: input-spec key prefixes the memo publication can stand in for
+_ENCFOLD_KEY_PREFIXES = frozenset({"num", "valid", "hll"})
+
+
+def classify_encfold_columns(
+    col_types: Dict[str, str],
+    analyzers,
+    specs: Dict[str, Any],
+    device_keys,
+    groups,
+    skip_groups=frozenset(),
+    int_bounds=None,
+):
+    """Pure encoded-fold eligibility split over a scan's native-reader
+    columns, proved statically — exactly like classify_reader_columns.
+
+    `col_types` maps the CANDIDATE columns (the reader set — encoded
+    fold ⊆ reader by construction) to their decode tokens; `analyzers`
+    are the pass's live members; `specs` the deduplicated input specs
+    (their key prefixes prove which consumers the memo publication can
+    serve); `device_keys` the member plan's device-consumed key set (a
+    device-packed column would expand its stub every batch — excluded);
+    `groups` the row_group_stats with page-placement fields;
+    `int_bounds` the statically pinned footer min/max per column. A
+    column qualifies only when EVERY live chunk is provably
+    all-dictionary-coded AND every consumer is memo-servable — one odd
+    chunk or consumer falls the whole column back, with a reason naming
+    the disqualifier (EXPLAIN's DQ325). Returns
+    (col -> EncFoldColSpec, falloffs). Shared verbatim by the planner
+    and the cost model so prediction and execution can never
+    disagree."""
+    from deequ_tpu.data import native_reader as nr
+    from deequ_tpu.data.encfold import EncFoldColSpec
+
+    live = [rg for rg in groups if rg.index not in skip_groups]
+    if not live:
+        return {}, [
+            (n, "codec: every row group is pruned")
+            for n in sorted(col_types)
+        ]
+    int_bounds = int_bounds or {}
+    # per-column consumer views: spec key prefixes + exact keys (for the
+    # device-placement exclusion), analyzer names
+    prefixes: Dict[str, set] = {}
+    keys_by_col: Dict[str, set] = {}
+    for spec in specs.values():
+        prefix = spec.key.split(":", 1)[0]
+        for col in spec.columns or ():
+            prefixes.setdefault(col, set()).add(prefix)
+            keys_by_col.setdefault(col, set()).add(spec.key)
+    names: Dict[str, set] = {}
+    wheres: Dict[str, set] = {}
+    for a in analyzers:
+        try:
+            a_cols = set()
+            for s in a.input_specs():
+                a_cols.update(s.columns or ())
+                if s.columns is None:
+                    # unknowable reads: the analyzer may touch anything
+                    a_cols.update(col_types)
+        except Exception:  # noqa: BLE001 - unknowable reads: consume all
+            a_cols = set(col_types)
+        for col in a_cols:
+            names.setdefault(col, set()).add(a.name)
+            if getattr(a, "where", None) is not None:
+                wheres.setdefault(col, set()).add(a.name)
+    enc: Dict[str, Any] = {}
+    falloffs: List[Tuple[str, str]] = []
+    for name in sorted(col_types):
+        token = col_types[name]
+        if token not in nr.ENCFOLD_TOKENS:
+            falloffs.append(
+                (name, f"dtype: no run-fold kernel for {token}")
+            )
+            continue
+        consumers = names.get(name, set())
+        bad = sorted(consumers - _ENCFOLD_ANALYZERS)
+        if bad:
+            falloffs.append(
+                (name, f"analyzer: {bad[0]} needs row-width values")
+            )
+            continue
+        filtered = sorted(wheres.get(name, ()))
+        if filtered:
+            falloffs.append(
+                (
+                    name,
+                    f"analyzer: {filtered[0]} carries a where filter "
+                    "(family memos publish unfiltered only)",
+                )
+            )
+            continue
+        extra = sorted(prefixes.get(name, set()) - _ENCFOLD_KEY_PREFIXES)
+        if extra:
+            falloffs.append(
+                (name, f"analyzer: consumer {extra[0]}: needs row values")
+            )
+            continue
+        if keys_by_col.get(name, set()) & set(device_keys):
+            falloffs.append(
+                (name, "analyzer: consumed by a device-placed member")
+            )
+            continue
+        has_sketch = bool(consumers & _ENCFOLD_SKETCH)
+        kind = "f64" if token in ("double", "float") else "i64"
+        bounds = int_bounds.get(name)
+        publish_moments = (
+            kind == "i64"
+            and "StandardDeviation" not in consumers
+            and bounds is not None
+            and -(1 << 31) < int(bounds[0])
+            and int(bounds[1]) < (1 << 31)
+        )
+        if "StandardDeviation" in consumers and not has_sketch:
+            falloffs.append(
+                (
+                    name,
+                    "analyzer: StandardDeviation without a sketch "
+                    "family needs the kernel's m2 stream",
+                )
+            )
+            continue
+        if not (has_sketch or publish_moments or
+                prefixes.get(name, set()) <= {"valid"}):
+            falloffs.append(
+                (
+                    name,
+                    "dict-size: no memo-servable consumer (moments "
+                    "bounds unproven and no sketch family)",
+                )
+            )
+            continue
+        reason = None
+        for rg in live:
+            st = rg.columns.get(name)
+            if st is None:
+                reason = (
+                    f"codec: row group {rg.index} carries no chunk "
+                    "layout metadata"
+                )
+                break
+            if (
+                getattr(st, "dictionary_page_offset", None) is None
+                or getattr(st, "data_page_offset", None) is None
+                or st.dictionary_page_offset >= st.data_page_offset
+            ):
+                reason = (
+                    f"codec: chunk in row group {rg.index} has no "
+                    "leading dictionary page"
+                )
+                break
+            encs = set(st.encodings or ())
+            if "RLE_DICTIONARY" in encs:
+                # v2 footers list PLAIN unconditionally (the dictionary
+                # page's own encoding): genuinely plain data pages fail
+                # closed per chunk at decode (PQE_UNSUPPORTED)
+                continue
+            if "PLAIN_DICTIONARY" not in encs:
+                reason = (
+                    f"codec: chunk in row group {rg.index} is not "
+                    "dictionary-coded"
+                )
+                break
+            if "PLAIN" in encs:
+                # v1 footers list PLAIN only when the writer actually
+                # fell back to plain data pages mid-chunk
+                reason = (
+                    f"codec: chunk in row group {rg.index} fell back "
+                    "to PLAIN data pages (dict-size overflow at write)"
+                )
+                break
+        if reason is not None:
+            falloffs.append((name, reason))
+            continue
+        enc[name] = EncFoldColSpec(
+            column=name,
+            token=token,
+            kind=kind,
+            publish_moments=publish_moments,
+        )
+    return enc, falloffs
 
 
 #: integer arrow tokens the wire kernels take (uint64 deliberately
@@ -1135,7 +1344,11 @@ def wire_int_bounds_from_groups(groups, columns) -> Dict[str, Any]:
 
 
 def plan_decode_fastpath(
-    table, specs: Dict[str, Any], member_plan=None, batch_size: int = 0
+    table,
+    specs: Dict[str, Any],
+    member_plan=None,
+    batch_size: int = 0,
+    analyzers=None,
 ):
     """Build a DecodePlan for a parquet-backed scan, or None when the
     knob is off, the source has no decode-planning surface, the native
@@ -1183,6 +1396,10 @@ def plan_decode_fastpath(
         reader_falloffs: Tuple[Tuple[str, str], ...] = ()
         reader_groups = 0
         reader_planned = False
+        enc_cols: Tuple[str, ...] = ()
+        enc_falloffs: Tuple[Tuple[str, str], ...] = ()
+        enc_specs = None
+        enc_planned = False
         if (
             runtime.native_reader_enabled()
             and getattr(table, "with_native_reader", None) is not None
@@ -1208,11 +1425,43 @@ def plan_decode_fastpath(
                     reader_cols = tuple(r_cols)
                     reader_falloffs = tuple(r_falloffs)
                     reader_planned = True
+                    # encoded-fold verdict layered on the reader set:
+                    # needs the live analyzers (consumer proofs) and
+                    # the encoded-fold source surface. Best-effort like
+                    # reader planning — a failure here must not cost
+                    # the reader set.
+                    if (
+                        reader_cols
+                        and analyzers is not None
+                        and member_plan is not None
+                        and runtime.encoded_fold_enabled()
+                        and getattr(table, "with_encoded_fold", None)
+                        is not None
+                    ):
+                        e_specs, e_falloffs = classify_encfold_columns(
+                            {c: col_types[c] for c in reader_cols},
+                            analyzers,
+                            specs,
+                            member_plan.device_keys,
+                            groups,
+                            skip,
+                            int_bounds=wire_int_bounds_from_groups(
+                                groups, sorted(reader_cols)
+                            ),
+                        )
+                        enc_cols = tuple(sorted(e_specs))
+                        enc_falloffs = tuple(e_falloffs)
+                        enc_specs = e_specs or None
+                        enc_planned = True
             except Exception:  # noqa: BLE001
                 reader_cols = ()
                 reader_falloffs = ()
                 reader_groups = 0
                 reader_planned = False
+                enc_cols = ()
+                enc_falloffs = ()
+                enc_specs = None
+                enc_planned = False
         return DecodePlan(
             fast=tuple(fast),
             fallbacks=tuple(fallbacks),
@@ -1225,6 +1474,10 @@ def plan_decode_fastpath(
             reader_falloffs=reader_falloffs,
             reader_groups=reader_groups,
             reader_planned=reader_planned,
+            enc_cols=enc_cols,
+            enc_falloffs=enc_falloffs,
+            enc_specs=enc_specs,
+            enc_planned=enc_planned,
         )
     except Exception:  # noqa: BLE001
         return None
@@ -1245,6 +1498,7 @@ def apply_decode_plan(table, plan: DecodePlan):
         cols_wire_fused=len(plan.wire_fused),
         cols_reader=len(plan.reader_cols),
         reader_groups=plan.reader_groups,
+        cols_encfold=len(plan.enc_cols),
         workers=plan.workers,
     ):
         pass
@@ -1263,6 +1517,12 @@ def apply_decode_plan(table, plan: DecodePlan):
         runtime.record_reader_chunks(
             native_chunks, total_chunks - native_chunks, total_chunks
         )
+    if plan.enc_planned:
+        # record-the-zeros contract for the encoded-fold column verdict
+        # (the STATIC half — per-unit run/fallback counters come from
+        # decode_unit): the trace side of cost_drift's encfold_columns
+        # pin sees 0 predicted == 0 observed rather than a missing series
+        runtime.record_encfold_plan(len(plan.enc_cols), plan.total)
     if plan.fast:
         table = table.with_decode_fastpath(plan.fast)
     if plan.wire_specs:
@@ -1275,6 +1535,10 @@ def apply_decode_plan(table, plan: DecodePlan):
         with_reader = getattr(table, "with_native_reader", None)
         if with_reader is not None:
             table = with_reader(plan.reader_cols)
+    if plan.enc_specs:
+        with_enc = getattr(table, "with_encoded_fold", None)
+        if with_enc is not None:
+            table = with_enc(plan.enc_specs)
     return table
 
 
@@ -1605,6 +1869,21 @@ def _precompute_family_kernels(
         ],
     )
     counts_ok = counts_family.enabled()
+    # encoded-fold publication: batches decoded through the run-fold
+    # path carry per-column value multisets (table.encfold payloads) —
+    # publishing their family memos HERE pre-empts both the counts
+    # shortcut and the select kernel below (a published qkey skips the
+    # job), deriving through the same counts_family code the row path's
+    # shortcut uses. Declining is always safe: the memo stays unset and
+    # the job runs against the stub's expanded rows, bit-identical.
+    enc = getattr(batch, "encfold", None) if batch is not None else None
+    if enc and counts_ok:
+        try:
+            from deequ_tpu.data import encfold as _encfold
+
+            _encfold.publish_memos(built, enc, planned)
+        except Exception:  # noqa: BLE001 - memos stay unset, jobs run
+            pass
     jobs = []
     for pj in planned:
         column, where, wkey = pj.column, pj.where, pj.wkey
@@ -1951,7 +2230,11 @@ class FusedScanPass:
             # columns that survived pruning (with_columns returns a new
             # source, so the fast set must attach to the final view)
             decode_plan = plan_decode_fastpath(
-                table, specs, member_plan=plan, batch_size=self.batch_size
+                table,
+                specs,
+                member_plan=plan,
+                batch_size=self.batch_size,
+                analyzers=[self.analyzers[i] for i in live_idx],
             )
             if decode_plan is not None:
                 table = apply_decode_plan(table, decode_plan)
@@ -2033,7 +2316,7 @@ class FusedScanPass:
                     self.batch_size if self._batch_size_explicit else None
                 ),
                 batch_rows=int(batch_rows) if batch_rows else None,
-                variant=runtime.fold_variant(),
+                variant=runtime.fold_signature_variant(),
             )
         if cap is not None:
             cap.note_plan_signature(signature)

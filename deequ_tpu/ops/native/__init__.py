@@ -51,6 +51,7 @@ _SOURCES = (
     os.path.join(_PKG_DIR, "xxhash_hll.c"),
     os.path.join(_PKG_DIR, "decode.c"),
     os.path.join(_PKG_DIR, "parquet_read.c"),
+    os.path.join(_PKG_DIR, "encfold.c"),
 )
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
@@ -409,6 +410,40 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64),
         ]
         lib.pq_decode_chunk.restype = ctypes.c_int64
+        lib.pq_decode_chunk_runs.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.pq_decode_chunk_runs.restype = ctypes.c_int64
+        # encfold.c: fold kernels over the encoded-run streams
+        lib.encfold_code_counts.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+        ]
+        lib.encfold_code_counts.restype = ctypes.c_int64
+        lib.encfold_def_nulls.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.encfold_def_nulls.restype = ctypes.c_int64
         _LIB = lib
     except OSError:
         _LIB = None
@@ -1151,6 +1186,134 @@ def read_chunk(
     if rc < 0:
         return None
     return rc, int(info[0]), int(info[1])
+
+
+#: dictionary-entry ceiling the encoded-fold mode accepts per chunk;
+#: pq_decode_chunk_runs fails with PQE_SIZE above it, so a column whose
+#: dictionary outgrew the bound falls back to the row-width path
+ENCFOLD_DICT_CAP = 65536
+
+
+@_traced_kernel
+def read_chunk_runs(
+    chunk: np.ndarray,
+    phys: int,
+    codec: int,
+    max_def: int,
+    num_values: int,
+    cap_dict: int = ENCFOLD_DICT_CAP,
+) -> Optional[tuple]:
+    """Decode one raw column-chunk byte range into encoded-run streams
+    instead of row-width buffers: coalesced (run_length, dict_code)
+    value runs plus (run_length, present) definition-level runs, with
+    the dictionary page's values in physical layout. Only fully
+    dictionary-coded chunks qualify — a PLAIN data page (dictionary
+    fallback mid-chunk), boolean column, oversized dictionary, or any
+    corrupt structure returns None and the caller decodes the chunk at
+    row width instead. Returns (dict_raw_bytes, run_len, run_code,
+    def_len, def_val, null_count, pages, uncompressed_bytes,
+    dict_count)."""
+    lib = _load()
+    if lib is None:
+        return None
+    item = {1: 4, 2: 8, 4: 4, 5: 8}.get(int(phys))
+    if item is None:
+        return None
+    nv = int(num_values)
+    cap_dict = int(cap_dict)
+    out_dict = np.zeros(max(cap_dict, 1) * item, dtype=np.uint8)
+    # coalescing bounds both streams by the footer row count
+    run_len = np.empty(max(nv, 1), dtype=np.int64)
+    run_code = np.empty(max(nv, 1), dtype=np.uint32)
+    def_len = np.empty(max(nv, 1), dtype=np.int64)
+    def_val = np.empty(max(nv, 1), dtype=np.uint8)
+    info = np.zeros(5, dtype=np.int64)
+    rc = lib.pq_decode_chunk_runs(
+        chunk.ctypes.data_as(ctypes.c_void_p),
+        int(len(chunk)),
+        int(phys),
+        int(codec),
+        int(max_def),
+        nv,
+        out_dict.ctypes.data_as(ctypes.c_void_p),
+        cap_dict,
+        run_len.ctypes.data_as(ctypes.c_void_p),
+        run_code.ctypes.data_as(ctypes.c_void_p),
+        int(len(run_len)),
+        def_len.ctypes.data_as(ctypes.c_void_p),
+        def_val.ctypes.data_as(ctypes.c_void_p),
+        int(len(def_len)),
+        info.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    rc = int(rc)
+    if rc < 0:
+        return None
+    n_runs, n_defs, dict_count = int(info[3]), int(info[4]), int(info[2])
+    # copy the live prefixes so the full-size scratch is freed promptly
+    return (
+        out_dict[: dict_count * item].copy(),
+        run_len[:n_runs].copy(),
+        run_code[:n_runs].copy(),
+        def_len[:n_defs].copy(),
+        def_val[:n_defs].copy(),
+        rc,
+        int(info[0]),
+        int(info[1]),
+        dict_count,
+    )
+
+
+@_traced_kernel
+def encfold_code_counts(
+    run_len: np.ndarray, run_code: np.ndarray, dict_count: int
+) -> Optional[np.ndarray]:
+    """Weighted bincount of a coalesced (run_length, dict_code) stream:
+    per-code occurrence counts, i.e. the slice's multiset over the
+    dictionary. Returns None when the native library is unavailable or
+    any run is corrupt (non-positive length, code out of range) — the
+    caller fails closed to the row-width path, never to wrong values."""
+    lib = _load()
+    if lib is None:
+        return None
+    run_len = np.ascontiguousarray(run_len, dtype=np.int64)
+    run_code = np.ascontiguousarray(run_code, dtype=np.uint32)
+    dict_count = int(dict_count)
+    counts = np.zeros(max(dict_count, 1), dtype=np.int64)
+    rc = lib.encfold_code_counts(
+        run_len.ctypes.data_as(ctypes.c_void_p),
+        run_code.ctypes.data_as(ctypes.c_void_p),
+        int(len(run_len)),
+        dict_count,
+        counts.ctypes.data_as(ctypes.c_void_p),
+    )
+    if int(rc) < 0:
+        return None
+    return counts[:dict_count]
+
+
+@_traced_kernel
+def encfold_def_nulls(
+    def_len: np.ndarray, def_val: np.ndarray, expect_rows: int = -1
+) -> Optional[int]:
+    """Null count from coalesced definition-level runs, with no
+    materialized validity mask. Returns None when the native library is
+    unavailable or any run is corrupt (non-positive length, non-boolean
+    def value, row-count mismatch against expect_rows when >= 0)."""
+    lib = _load()
+    if lib is None:
+        return None
+    def_len = np.ascontiguousarray(def_len, dtype=np.int64)
+    def_val = np.ascontiguousarray(def_val, dtype=np.uint8)
+    rc = lib.encfold_def_nulls(
+        def_len.ctypes.data_as(ctypes.c_void_p),
+        def_val.ctypes.data_as(ctypes.c_void_p),
+        int(len(def_len)),
+        int(expect_rows),
+    )
+    rc = int(rc)
+    if rc < 0:
+        return None
+    return rc
 
 
 @_traced_kernel

@@ -1116,3 +1116,458 @@ done:
     free(st.idx.p);
     return rc;
 }
+
+/* ---- encoded-run output mode ----
+ *
+ * pq_decode_chunk_runs() walks the same page structure but never
+ * expands to row width: dictionary-coded value streams come out as
+ * coalesced (run_length, dict_code) pairs and definition levels as
+ * (run_length, present) pairs, straight off the RLE/bit-packed hybrid
+ * stream. Scope is narrower than pq_decode_chunk on purpose — every
+ * data page must be RLE_DICTIONARY/PLAIN_DICTIONARY (a PLAIN data page,
+ * e.g. a dictionary fallback mid-chunk, fails closed with
+ * PQE_UNSUPPORTED and the Python layer re-decodes at row width).
+ * Adjacent equal codes coalesce across page boundaries, so n_runs never
+ * exceeds the non-null value count and n_defs never exceeds num_values
+ * — the caller sizes the output arrays from the footer row count.
+ */
+
+typedef struct {
+    int64_t *run_len;  /* coalesced non-null value runs */
+    uint32_t *run_code;
+    int64_t cap_runs;
+    int64_t n_runs;
+    int64_t *def_len;  /* coalesced definition-level runs */
+    uint8_t *def_val;  /* 0 = null rows, 1 = present rows */
+    int64_t cap_defs;
+    int64_t n_defs;
+    int64_t nn;        /* non-null rows accumulated via defs_push */
+} runs_out_t;
+
+static int runs_push(runs_out_t *r, int64_t len, uint32_t code) {
+    if (len <= 0) return PQE_THRIFT;
+    if (r->n_runs > 0 && r->run_code[r->n_runs - 1] == code) {
+        r->run_len[r->n_runs - 1] += len;
+        return 0;
+    }
+    if (r->n_runs >= r->cap_runs) return PQE_SIZE;
+    r->run_len[r->n_runs] = len;
+    r->run_code[r->n_runs] = code;
+    r->n_runs++;
+    return 0;
+}
+
+static int defs_push(runs_out_t *r, int64_t len, uint32_t val) {
+    if (len <= 0) return PQE_THRIFT;
+    if (val) r->nn += len;
+    if (r->n_defs > 0 && r->def_val[r->n_defs - 1] == (uint8_t)val) {
+        r->def_len[r->n_defs - 1] += len;
+        return 0;
+    }
+    if (r->n_defs >= r->cap_defs) return PQE_SIZE;
+    r->def_len[r->n_defs] = len;
+    r->def_val[r->n_defs] = (uint8_t)val;
+    r->n_defs++;
+    return 0;
+}
+
+/* Decode exactly `count` entries of an RLE/bit-packed hybrid stream as
+ * runs. An RLE run becomes one push; bit-packed groups unpack through
+ * the same unpack8 the row path uses and push per value (coalescing
+ * absorbs repeats). Every value must be < `bound`: dict codes check
+ * against the dictionary size (PQE_DICT), def levels against
+ * max_def + 1 (PQE_UNSUPPORTED — nested schema, not proven). Returns
+ * bytes consumed or PQE_*. */
+static int64_t hybrid_to_runs(const uint8_t *in, int64_t in_len, int bw,
+                              int64_t count, uint32_t bound, runs_out_t *r,
+                              int to_defs) {
+    if (bw < 0 || bw > 32) return PQE_UNSUPPORTED;
+    if (count == 0) return 0;
+    if (bw == 0) {
+        if (bound == 0) return to_defs ? PQE_UNSUPPORTED : PQE_DICT;
+        int rc = to_defs ? defs_push(r, count, 0) : runs_push(r, count, 0);
+        if (rc < 0) return rc;
+        return 0;
+    }
+    tin_t t = {in, in + in_len, 0};
+    int64_t got = 0;
+    int vbytes = (bw + 7) >> 3;
+    while (got < count) {
+        uint64_t header = t_uvarint(&t);
+        if (t.err) return PQE_TRUNCATED;
+        if ((header & 1) == 0) {
+            int64_t run = (int64_t)(header >> 1);
+            if (run <= 0) return PQE_THRIFT;
+            if ((uint64_t)(t.end - t.p) < (uint64_t)vbytes)
+                return PQE_TRUNCATED;
+            uint32_t v = 0;
+            for (int i = 0; i < vbytes; i++) v |= (uint32_t)t.p[i] << (8 * i);
+            t.p += vbytes;
+            if (bw < 32) v &= (uint32_t)(((uint64_t)1 << bw) - 1);
+            if (v >= bound) return to_defs ? PQE_UNSUPPORTED : PQE_DICT;
+            int64_t take = run < count - got ? run : count - got;
+            int rc = to_defs ? defs_push(r, take, v) : runs_push(r, take, v);
+            if (rc < 0) return rc;
+            got += take;
+        } else {
+            int64_t groups = (int64_t)(header >> 1);
+            if (groups <= 0) return PQE_THRIFT;
+            /* same pre-multiplication bound as hybrid_u32: groups is a
+             * raw varint and could overflow nvals/nbytes otherwise */
+            if (groups > (int64_t)(t.end - t.p)) return PQE_TRUNCATED;
+            int64_t nvals = groups * 8;
+            int64_t nbytes = groups * bw;
+            if ((int64_t)(t.end - t.p) < nbytes) return PQE_TRUNCATED;
+            int64_t take = nvals < count - got ? nvals : count - got;
+            const uint8_t *gp = t.p;
+            int64_t done = 0;
+            while (done < take) {
+                uint32_t tmp[8];
+                gp = unpack8(gp, bw, tmp);
+                int64_t m = take - done < 8 ? take - done : 8;
+                for (int64_t i = 0; i < m; i++) {
+                    uint32_t v = tmp[i];
+                    if (v >= bound)
+                        return to_defs ? PQE_UNSUPPORTED : PQE_DICT;
+                    int rc = to_defs ? defs_push(r, 1, v) : runs_push(r, 1, v);
+                    if (rc < 0) return rc;
+                }
+                done += m;
+            }
+            t.p += nbytes;
+            got += take;
+        }
+    }
+    return (int64_t)(t.p - in);
+}
+
+/* Entry point for the encoded-run mode.
+ *
+ * chunk/chunk_len, phys, codec, max_def, num_values: as pq_decode_chunk
+ * (booleans are out of scope — their pages are not dictionary-coded).
+ * out_dict: caller buffer for cap_dict dictionary entries in PHYSICAL
+ * layout (phys_itemsize bytes each; a dictionary larger than cap_dict
+ * fails with PQE_SIZE so the planner's entry bound is enforced here).
+ * run_len/run_code: caller buffers for cap_runs coalesced value runs.
+ * def_len/def_val: caller buffers for cap_defs coalesced def runs.
+ * out_info: [0]=pages, [1]=uncompressed bytes, [2]=dict entries,
+ * [3]=n_runs, [4]=n_defs.
+ *
+ * Returns the chunk null count (>= 0) or a negative PQE_* error.
+ */
+int64_t pq_decode_chunk_runs(const uint8_t *chunk, int64_t chunk_len,
+                             int32_t phys, int32_t codec, int32_t max_def,
+                             int64_t num_values, uint8_t *out_dict,
+                             int64_t cap_dict, int64_t *run_len,
+                             uint32_t *run_code, int64_t cap_runs,
+                             int64_t *def_len, uint8_t *def_val,
+                             int64_t cap_defs, int64_t *out_info) {
+    if (!chunk || chunk_len < 0 || num_values < 0 || !out_dict || !run_len ||
+        !run_code || !def_len || !def_val || cap_dict < 0)
+        return PQE_UNSUPPORTED;
+    if (max_def < 0 || max_def > 1) return PQE_UNSUPPORTED;
+    if (codec != CODEC_NONE && codec != CODEC_SNAPPY && codec != CODEC_ZSTD)
+        return PQE_CODEC;
+    int src_size = phys_itemsize(phys);
+    if (src_size == 0) return PQE_UNSUPPORTED; /* incl. PT_BOOLEAN */
+
+    runs_out_t r;
+    memset(&r, 0, sizeof(r));
+    r.run_len = run_len;
+    r.run_code = run_code;
+    r.cap_runs = cap_runs;
+    r.def_len = def_len;
+    r.def_val = def_val;
+    r.cap_defs = cap_defs;
+
+    buf_t page;
+    memset(&page, 0, sizeof(page));
+    int64_t dict_count = 0;
+    int saw_dict = 0;
+    int64_t pages = 0;
+    int64_t bytes_uncompressed = 0;
+    int64_t row = 0;
+    int64_t nulls = 0;
+    int64_t rc = 0;
+    const uint8_t *p = chunk;
+    const uint8_t *chunk_end = chunk + chunk_len;
+
+    while (p < chunk_end && row < num_values) {
+        tin_t t = {p, chunk_end, 0};
+        page_header_t h;
+        int hrc = parse_page_header(&t, &h);
+        if (hrc < 0) {
+            rc = hrc;
+            goto done;
+        }
+        const uint8_t *body = t.p;
+        if (chunk_end - body < h.compressed_size) {
+            rc = PQE_TRUNCATED;
+            goto done;
+        }
+        p = body + h.compressed_size;
+        pages++;
+
+        if (h.page_type == PAGE_INDEX) continue;
+
+        if (h.page_type == PAGE_DICT) {
+            if (saw_dict) {
+                rc = PQE_DICT;
+                goto done;
+            }
+            if (h.dict_encoding != ENC_PLAIN &&
+                h.dict_encoding != ENC_PLAIN_DICT) {
+                rc = PQE_UNSUPPORTED;
+                goto done;
+            }
+            if (h.dict_num_values < 0) {
+                rc = PQE_THRIFT;
+                goto done;
+            }
+            /* same wrap-proof divide bound as the row path */
+            if (h.dict_num_values > h.uncompressed_size / src_size) {
+                rc = PQE_SIZE;
+                goto done;
+            }
+            if (h.dict_num_values > cap_dict) {
+                rc = PQE_SIZE; /* planner's dictionary-entry bound */
+                goto done;
+            }
+            const uint8_t *data;
+            if (codec == CODEC_NONE) {
+                if (h.compressed_size != h.uncompressed_size) {
+                    rc = PQE_SIZE;
+                    goto done;
+                }
+                data = body;
+            } else {
+                int brc = buf_reserve(&page, h.uncompressed_size);
+                if (brc < 0) {
+                    rc = brc;
+                    goto done;
+                }
+                int drc = pq_decompress(codec, body, h.compressed_size,
+                                        page.p, h.uncompressed_size);
+                if (drc < 0) {
+                    rc = drc;
+                    goto done;
+                }
+                data = page.p;
+            }
+            dict_count = h.dict_num_values;
+            saw_dict = 1;
+            if (dict_count > 0)
+                memcpy(out_dict, data, (size_t)(dict_count * src_size));
+            bytes_uncompressed += h.uncompressed_size;
+            continue;
+        }
+
+        if (h.page_type == PAGE_DATA) {
+            if (h.num_values < 0 || h.encoding < 0) {
+                rc = PQE_THRIFT;
+                goto done;
+            }
+            if (h.encoding != ENC_RLE_DICT && h.encoding != ENC_PLAIN_DICT) {
+                rc = PQE_UNSUPPORTED; /* plain data page: fail closed */
+                goto done;
+            }
+            if (dict_count <= 0) {
+                rc = PQE_DICT;
+                goto done;
+            }
+            int64_t nv = h.num_values;
+            if (row + nv > num_values) {
+                rc = PQE_ROWS;
+                goto done;
+            }
+            const uint8_t *data;
+            if (codec == CODEC_NONE) {
+                if (h.compressed_size != h.uncompressed_size) {
+                    rc = PQE_SIZE;
+                    goto done;
+                }
+                data = body;
+            } else {
+                int brc = buf_reserve(&page, h.uncompressed_size);
+                if (brc < 0) {
+                    rc = brc;
+                    goto done;
+                }
+                int drc = pq_decompress(codec, body, h.compressed_size,
+                                        page.p, h.uncompressed_size);
+                if (drc < 0) {
+                    rc = drc;
+                    goto done;
+                }
+                data = page.p;
+            }
+            int64_t data_len = h.uncompressed_size;
+            const uint8_t *vals = data;
+            int64_t vals_len = data_len;
+            int64_t nn = nv;
+            if (max_def > 0) {
+                if (h.def_encoding != ENC_RLE) {
+                    rc = PQE_UNSUPPORTED;
+                    goto done;
+                }
+                if (data_len < 4) {
+                    rc = PQE_TRUNCATED;
+                    goto done;
+                }
+                uint32_t dl = (uint32_t)data[0] | ((uint32_t)data[1] << 8) |
+                              ((uint32_t)data[2] << 16) |
+                              ((uint32_t)data[3] << 24);
+                if ((int64_t)dl > data_len - 4) {
+                    rc = PQE_TRUNCATED;
+                    goto done;
+                }
+                int64_t nn_before = r.nn;
+                int64_t drc = hybrid_to_runs(data + 4, (int64_t)dl, 1, nv,
+                                             (uint32_t)(max_def + 1), &r, 1);
+                if (drc < 0) {
+                    rc = drc;
+                    goto done;
+                }
+                nn = r.nn - nn_before;
+                vals = data + 4 + dl;
+                vals_len = data_len - 4 - (int64_t)dl;
+            } else {
+                int drc = defs_push(&r, nv, 1);
+                if (drc < 0) {
+                    rc = drc;
+                    goto done;
+                }
+            }
+            nulls += nv - nn;
+            if (vals_len < 1) {
+                rc = PQE_TRUNCATED;
+                goto done;
+            }
+            int bw = vals[0];
+            int64_t vrc = hybrid_to_runs(vals + 1, vals_len - 1, bw, nn,
+                                         (uint32_t)dict_count, &r, 0);
+            if (vrc < 0) {
+                rc = vrc;
+                goto done;
+            }
+            row += nv;
+            bytes_uncompressed += h.uncompressed_size;
+            continue;
+        }
+
+        if (h.page_type == PAGE_DATA_V2) {
+            if (h.v2_num_values < 0 || h.v2_encoding < 0 || h.v2_dl_len < 0 ||
+                h.v2_rl_len < 0) {
+                rc = PQE_THRIFT;
+                goto done;
+            }
+            if (h.v2_rl_len != 0) {
+                rc = PQE_UNSUPPORTED;
+                goto done;
+            }
+            if (h.v2_encoding != ENC_RLE_DICT &&
+                h.v2_encoding != ENC_PLAIN_DICT) {
+                rc = PQE_UNSUPPORTED;
+                goto done;
+            }
+            if (dict_count <= 0) {
+                rc = PQE_DICT;
+                goto done;
+            }
+            int64_t nv = h.v2_num_values;
+            if (row + nv > num_values) {
+                rc = PQE_ROWS;
+                goto done;
+            }
+            int64_t lvl_len = h.v2_dl_len;
+            if (lvl_len > h.compressed_size || lvl_len > h.uncompressed_size) {
+                rc = PQE_TRUNCATED;
+                goto done;
+            }
+            int64_t nn = nv;
+            if (max_def > 0) {
+                int64_t nn_before = r.nn;
+                int64_t drc = hybrid_to_runs(body, lvl_len, 1, nv,
+                                             (uint32_t)(max_def + 1), &r, 1);
+                if (drc < 0) {
+                    rc = drc;
+                    goto done;
+                }
+                nn = r.nn - nn_before;
+            } else {
+                if (lvl_len != 0) {
+                    rc = PQE_UNSUPPORTED;
+                    goto done;
+                }
+                int drc = defs_push(&r, nv, 1);
+                if (drc < 0) {
+                    rc = drc;
+                    goto done;
+                }
+            }
+            nulls += nv - nn;
+            const uint8_t *vsrc = body + lvl_len;
+            int64_t vsrc_len = h.compressed_size - lvl_len;
+            int64_t vdst_len = h.uncompressed_size - lvl_len;
+            if (vdst_len < 0) {
+                rc = PQE_SIZE;
+                goto done;
+            }
+            const uint8_t *vals;
+            if (h.v2_is_compressed && codec != CODEC_NONE) {
+                int brc = buf_reserve(&page, vdst_len > 0 ? vdst_len : 1);
+                if (brc < 0) {
+                    rc = brc;
+                    goto done;
+                }
+                int drc = pq_decompress(codec, vsrc, vsrc_len, page.p,
+                                        vdst_len);
+                if (drc < 0) {
+                    rc = drc;
+                    goto done;
+                }
+                vals = page.p;
+            } else {
+                if (vsrc_len != vdst_len) {
+                    rc = PQE_SIZE;
+                    goto done;
+                }
+                vals = vsrc;
+            }
+            if (vdst_len < 1) {
+                rc = PQE_TRUNCATED;
+                goto done;
+            }
+            int bw = vals[0];
+            int64_t vrc = hybrid_to_runs(vals + 1, vdst_len - 1, bw, nn,
+                                         (uint32_t)dict_count, &r, 0);
+            if (vrc < 0) {
+                rc = vrc;
+                goto done;
+            }
+            row += nv;
+            bytes_uncompressed += h.uncompressed_size;
+            continue;
+        }
+
+        rc = PQE_UNSUPPORTED;
+        goto done;
+    }
+
+    if (row != num_values) {
+        rc = PQE_ROWS;
+        goto done;
+    }
+    rc = nulls;
+
+done:
+    if (out_info) {
+        out_info[0] = pages;
+        out_info[1] = bytes_uncompressed;
+        out_info[2] = dict_count;
+        out_info[3] = r.n_runs;
+        out_info[4] = r.n_defs;
+    }
+    free(page.p);
+    return rc;
+}

@@ -323,6 +323,28 @@ def fold_variant() -> str:
     return "pallas-folds" if pallas_kernels.usable() else ""
 
 
+def fold_signature_variant() -> str:
+    """The variant tag plan signatures actually hash: `fold_variant`
+    plus an "encfold" mode tag whenever the encoded-fold path could
+    engage (kill switch on, the native reader stack it rides on
+    enabled, and the native library loadable). Encoded-fold results are
+    bit-identical to the row fold by construction, but cached states
+    must still never mix across the two fold modes — same conservatism
+    as the pallas tag, applied to a mode that changes where states come
+    from rather than their arithmetic."""
+    base = fold_variant()
+    if (
+        encoded_fold_enabled()
+        and native_reader_enabled()
+        and decode_fastpath_enabled()
+    ):
+        from deequ_tpu.ops import native
+
+        if native.available():
+            return base + "+encfold" if base else "encfold"
+    return base
+
+
 def shard_tag() -> str:
     """This process's shard tag in a sharded scan (`DEEQU_TPU_SHARD`,
     set by the mesh launcher for each worker): a short string like "2"
@@ -350,6 +372,26 @@ def native_reader_enabled() -> bool:
     import os
 
     return os.environ.get("DEEQU_TPU_NATIVE_READER", "") not in ("0", "off")
+
+
+def encoded_fold_enabled() -> bool:
+    """Whether planner-approved dictionary-coded columns may fold
+    analyzer family state over (run_length, dict_code) streams straight
+    off the page decoder (ops/native/parquet_read.c runs mode +
+    ops/native/encfold.c) instead of expanding to row width first.
+
+    `DEEQU_TPU_ENCODED_FOLD=0` (or `off`) is the kill switch: every
+    chunk expands to rows exactly as before — the baseline the
+    encoded-fold differential suite compares against. The run-fold
+    derivations share the row path's counts-family code and decline
+    whenever bit-identity is not proven for a batch, so metrics are
+    bit-identical either way; only how many bytes get materialized
+    changes. The mode still enters the plan signature
+    (`fold_signature_variant`) so cached states never mix across the
+    two fold paths."""
+    import os
+
+    return os.environ.get("DEEQU_TPU_ENCODED_FOLD", "") not in ("0", "off")
 
 
 def forensics_enabled() -> bool:
@@ -799,6 +841,23 @@ def record_window(
 
 def record_reader_chunks(native: int, fallback: int, total: int) -> None:
     _counters.record_reader_chunks(native, fallback, total)
+
+
+def record_encfold_plan(cols: int, total: int) -> None:
+    _counters.record_encfold_plan(cols, total)
+
+
+def record_encfold(
+    chunks: int,
+    fallback: int,
+    runs: int,
+    values: int,
+    codes: int,
+    bytes_saved: int,
+) -> None:
+    _counters.record_encfold(
+        chunks, fallback, runs, values, codes, bytes_saved
+    )
 
 
 def record_retry(attempts: int, recovered: int, exhausted: int) -> None:

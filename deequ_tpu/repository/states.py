@@ -135,7 +135,7 @@ def plan_signature_for(
         compute_dtype=np.dtype(runtime.compute_dtype()).name,
         batch_size=batch_size,
         batch_rows=int(batch_rows) if batch_rows else None,
-        variant=runtime.fold_variant(),
+        variant=runtime.fold_signature_variant(),
     )
 
 

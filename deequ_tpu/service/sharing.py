@@ -97,7 +97,7 @@ def current_plan_env(table: Any, batch_size: Optional[int] = None):
         compute_dtype=np.dtype(runtime.compute_dtype()).name,
         batch_size=batch_size,
         batch_rows=int(batch_rows) if batch_rows else None,
-        fold_variant=runtime.fold_variant(),
+        fold_variant=runtime.fold_signature_variant(),
     )
 
 

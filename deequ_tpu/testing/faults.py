@@ -66,7 +66,7 @@ ENV_KNOB = "DEEQU_TPU_FAULTS"
 #: raises InjectedFaultError), "sleep" (the point blocks for the plan's
 #: stall seconds), "data" (the point returns a directive the call site
 #: applies: read.short -> "short", read.corrupt -> "corrupt",
-#: decode.chunk -> "fail", shard.merge -> "corrupt",
+#: decode.chunk / decode.runs -> "fail", shard.merge -> "corrupt",
 #: shard.host_loss -> "lost").
 FAULT_KINDS: Dict[str, str] = {
     # readahead pool / object-store fetch path (data/source.py)
@@ -76,6 +76,7 @@ FAULT_KINDS: Dict[str, str] = {
     "read.corrupt": "data",    # corrupt page bytes reach the decoder
     # native page decode (data/source.py decode side)
     "decode.chunk": "data",    # one column chunk fails to decode
+    "decode.runs": "data",     # a run-length stream corrupts mid-chunk
     "decode.worker": "raise",  # a decode worker dies mid-unit
     # staged stream pipeline (ops/pipeline.py)
     "pipeline.stage": "raise",  # the stage worker raises mid-batch
@@ -167,6 +168,7 @@ class FaultPlan:
             "read.short": "short",
             "read.corrupt": "corrupt",
             "decode.chunk": "fail",
+            "decode.runs": "fail",
             "shard.merge": "corrupt",
             "shard.host_loss": "lost",
         }[point]

@@ -1,0 +1,491 @@
+"""Encoded-fold directed tests (ISSUE 20): run-length and
+dictionary-aware fold kernels.
+
+Four layers:
+
+* chunk-level — `decode_chunk_runs` on crafted chunks (long runs,
+  bit-packed alternation, all-null pages) must expand via `expand_runs`
+  to exactly what the row-width `decode_chunk` produces, bit for bit;
+* fail-closed — a dictionary past the code cap, corrupt run streams,
+  and the `decode.runs` chaos directive must fall the chunk back to the
+  row-width path with identical results, never wrong values;
+* planner — `classify_encfold_columns` names the disqualifying
+  property per column (DQ325), the EXPLAIN plan line renders the
+  runs/dict split, and the plan signature is keyed on the fold mode so
+  encoded-fold states never mix with row-fold cache entries;
+* suite-level — end-to-end scans with the fold on vs the
+  `DEEQU_TPU_ENCODED_FOLD=0` kill switch must be bit-identical while
+  the `engine.encfold.*` counters prove the fold actually engaged.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from deequ_tpu import observe
+from deequ_tpu.data import native_reader as nr
+from deequ_tpu.data.source import ParquetSource
+from deequ_tpu.ops import native, runtime
+from deequ_tpu.testing import faults
+
+requires_native = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+pytestmark = pytest.mark.usefixtures("_host_placement")
+
+
+@pytest.fixture
+def _host_placement(monkeypatch):
+    monkeypatch.setenv("DEEQU_TPU_PLACEMENT", "host")
+
+
+def _write(table, path, version="1.0", row_group_size=None, **kw):
+    pq.write_table(
+        table,
+        path,
+        compression="NONE",
+        version=version,
+        row_group_size=row_group_size or table.num_rows,
+        **kw,
+    )
+
+
+def _chunk(tmp_path, column_arrays, name, version="1.0", **kw):
+    """Raw bytes + meta of every (group, column) chunk of a file."""
+    path = tmp_path / f"{name}.parquet"
+    _write(pa.table(column_arrays), path, version=version, **kw)
+    src = ParquetSource(str(path))
+    metas = src._reader_chunk_meta(frozenset(column_arrays))
+    fd = os.open(str(path), os.O_RDONLY)
+    try:
+        return {
+            key: (nr.fetch_chunk(fd, meta), meta)
+            for key, meta in metas.items()
+        }
+    finally:
+        os.close(fd)
+
+
+def _assert_expansion_bit_identical(raw, meta):
+    """decode_chunk_runs -> expand_runs must equal decode_chunk exactly."""
+    rc = nr.decode_chunk_runs(raw, meta)
+    assert rc is not None, meta.column
+    row = nr.decode_chunk(raw, meta)
+    assert row is not None, meta.column
+    exp = nr.expand_runs(rc)
+    assert exp is not None, meta.column
+    assert rc.null_count == row.null_count
+    assert exp.null_count == row.null_count
+    assert exp.num_values == row.num_values
+    if row.validity is None:
+        assert exp.validity is None or np.array_equal(
+            np.unpackbits(exp.validity), np.unpackbits(exp.validity)
+        )
+    else:
+        nbits = row.num_values
+        assert np.array_equal(
+            np.unpackbits(exp.validity, bitorder="little")[:nbits],
+            np.unpackbits(row.validity, bitorder="little")[:nbits],
+        )
+    # raw value bits (uint views: NaN payloads and signed zeros count)
+    a = exp.values.view(np.uint64 if exp.values.itemsize == 8 else np.uint32)
+    b = row.values.view(np.uint64 if row.values.itemsize == 8 else np.uint32)
+    assert np.array_equal(a, b), meta.column
+    return rc
+
+
+@requires_native
+@pytest.mark.parametrize("version", ["1.0", "2.6"])
+def test_runs_decode_long_runs_bit_identical(tmp_path, version):
+    """Sorted low-cardinality data: few long runs. The run count must
+    collapse far below the row count, and expansion must be exact."""
+    n = 6000
+    sorted_vals = np.sort(np.repeat(np.arange(12, dtype=np.int64), n // 12))
+    rng = np.random.default_rng(5)
+    chunks = _chunk(
+        tmp_path,
+        {
+            "long": pa.array(sorted_vals),
+            "nullish": pa.array(
+                sorted_vals.astype(np.float64) * 0.5,
+                mask=rng.random(n) < 0.15,
+            ),
+        },
+        f"longruns_{version}",
+        version=version,
+    )
+    for (g, name), (raw, meta) in chunks.items():
+        rc = _assert_expansion_bit_identical(raw, meta)
+        if name == "long":
+            assert len(rc.run_len) < n // 50, "runs did not coalesce"
+        assert int(np.sum(rc.run_len)) == rc.num_values - rc.null_count
+
+
+@requires_native
+def test_runs_decode_bitpacked_groups_bit_identical(tmp_path):
+    """High-frequency alternation: the RLE/bit-packed hybrid emits
+    bit-packed groups, the worst case for coalescing — expansion must
+    still be exact and the def-level fold must match the page loop."""
+    n = 4097  # ends mid bit-packed group
+    rng = np.random.default_rng(11)
+    vals = rng.integers(0, 64, size=n).astype(np.int64)
+    chunks = _chunk(
+        tmp_path,
+        {"alt": pa.array(vals, mask=rng.random(n) < 0.5)},
+        "bitpacked",
+        data_page_size=2048,
+    )
+    for (g, name), (raw, meta) in chunks.items():
+        rc = _assert_expansion_bit_identical(raw, meta)
+        folded = native.encfold_def_nulls(
+            rc.def_len, rc.def_val, rc.num_values
+        )
+        assert folded == rc.null_count
+
+
+@requires_native
+def test_runs_decode_all_null_def_runs(tmp_path):
+    """All-null pages inside a dictionary-coded chunk: the leading
+    pages carry only definition levels (long zero runs), and the null
+    count comes from the def runs alone with no materialized validity
+    mask. A chunk that is entirely null (pyarrow writes an empty
+    dictionary) fails closed in BOTH decoders — the pyarrow fallback
+    owns it, exactly like the row-width reader always has."""
+    n = 5000
+    vals = np.full(n, None, dtype=object)
+    vals[-400:] = [float(i % 6) for i in range(400)]
+    chunks = _chunk(
+        tmp_path,
+        {"mostly": pa.array(list(vals), type=pa.float64())},
+        "allnullpages",
+        data_page_size=1024,
+    )
+    ((g, name), (raw, meta)) = next(iter(chunks.items()))
+    rc = _assert_expansion_bit_identical(raw, meta)
+    assert rc.null_count == n - 400
+    assert int(np.sum(rc.run_len)) == 400
+    assert native.encfold_def_nulls(rc.def_len, rc.def_val, n) == n - 400
+    # long all-null def runs actually coalesced (not one run per page)
+    assert int(rc.def_len.max()) > 1024
+
+    chunks = _chunk(
+        tmp_path,
+        {"gone": pa.array([None] * 1500, type=pa.float64())},
+        "allnull",
+    )
+    ((g, name), (raw, meta)) = next(iter(chunks.items()))
+    assert nr.decode_chunk_runs(raw, meta) is None
+    assert nr.decode_chunk(raw, meta) is None  # pre-existing row behavior
+
+
+@requires_native
+def test_dict_code_overflow_fails_closed(tmp_path):
+    """A dictionary wider than ENCFOLD_DICT_CAP distinct values: the
+    footer still shows a pure-dictionary chunk (the planner approves),
+    but the runs decoder must refuse at decode time — fail closed to
+    the row-width path, never a truncated dictionary."""
+    n = native.ENCFOLD_DICT_CAP + 1000
+    vals = np.arange(n, dtype=np.int64)  # every value distinct
+    chunks = _chunk(
+        tmp_path,
+        {"wide": pa.array(vals)},
+        "overflow",
+        use_dictionary=True,
+        dictionary_pagesize_limit=1 << 21,
+    )
+    ((g, name), (raw, meta)) = next(iter(chunks.items()))
+    if nr.decode_chunk(raw, meta) is None:
+        pytest.skip("writer did not produce a decodable chunk")
+    assert nr.decode_chunk_runs(raw, meta) is None
+
+
+@requires_native
+def test_corrupt_run_streams_fail_closed():
+    """The fold kernels reject corrupt run structure: non-positive run
+    lengths, out-of-range codes, and def-run row counts that disagree
+    with the slice are -1 (None), never a wrong fold."""
+    run_len = np.array([3, 5, 2], dtype=np.int64)
+    run_code = np.array([0, 1, 0], dtype=np.uint32)
+    counts = native.encfold_code_counts(run_len, run_code, 2)
+    assert counts is not None and counts.tolist() == [5, 5]
+    bad_len = run_len.copy()
+    bad_len[1] = 0
+    assert native.encfold_code_counts(bad_len, run_code, 2) is None
+    bad_code = run_code.copy()
+    bad_code[2] = 9
+    assert native.encfold_code_counts(run_len, bad_code, 2) is None
+    def_len = np.array([7, 3], dtype=np.int64)
+    def_val = np.array([1, 0], dtype=np.uint8)
+    assert native.encfold_def_nulls(def_len, def_val, 10) == 3
+    assert native.encfold_def_nulls(def_len, def_val, 11) is None
+    assert native.encfold_def_nulls(
+        def_len, np.array([1, 2], dtype=np.uint8), 10
+    ) is None
+
+
+def _low_card_table(n=12000, seed=3):
+    rng = np.random.default_rng(seed)
+    return pa.table(
+        {
+            "code": pa.array(
+                rng.integers(0, 40, n).astype(np.int64),
+                mask=rng.random(n) < 0.07,
+            ),
+            "price": pa.array(
+                rng.choice(np.round(rng.normal(0, 5, 25), 3), n),
+                mask=rng.random(n) < 0.05,
+            ),
+        }
+    )
+
+
+def _run_suite(path, batch_rows=8192):
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        ApproxQuantile,
+        Completeness,
+        Maximum,
+        Mean,
+        Minimum,
+        Sum,
+    )
+    from deequ_tpu.runners import AnalysisRunner
+
+    res = (
+        AnalysisRunner()
+        .on_data(ParquetSource(path, batch_rows=batch_rows))
+        .add_analyzers(
+            [
+                Mean("code"),
+                Sum("code"),
+                Minimum("code"),
+                Maximum("code"),
+                Completeness("code"),
+                ApproxQuantile("price", 0.5),
+                ApproxCountDistinct("price"),
+                Mean("price"),
+            ]
+        )
+        .run()
+    )
+    return {
+        repr(a): repr(m.value.get() if not m.value.is_failure else None)
+        for a, m in res.metric_map.items()
+    }
+
+
+@requires_native
+def test_suite_bit_identical_and_counters(tmp_path, monkeypatch):
+    """End to end: encoded fold on vs the kill switch must be
+    bit-identical, and under a tracer the fold must actually engage
+    (planner approval, run-folded chunks, run/value/code counters,
+    runs_native span attrs)."""
+    path = str(tmp_path / "enc.parquet")
+    _write(_low_card_table(), path, row_group_size=4096)
+
+    monkeypatch.setenv("DEEQU_TPU_ENCODED_FOLD", "0")
+    baseline = _run_suite(path)
+    with observe.tracing() as off_tracer:
+        assert _run_suite(path) == baseline
+    assert "encfold_chunks" not in off_tracer.counters
+    assert "encfold_cols" not in off_tracer.counters
+
+    monkeypatch.setenv("DEEQU_TPU_ENCODED_FOLD", "1")
+    with observe.tracing() as tracer:
+        assert _run_suite(path) == baseline
+    c = tracer.counters
+    assert c.get("encfold_cols", 0) == 2
+    assert c.get("encfold_chunks", 0) > 0
+    assert c.get("encfold_runs", 0) > 0
+    assert c.get("encfold_values", 0) > 0
+    assert c.get("encfold_codes_folded", 0) > 0
+
+    def _spans(root):
+        yield root
+        for ch in root.children:
+            yield from _spans(ch)
+
+    decodes = [
+        sp
+        for root in tracer.roots
+        for sp in _spans(root)
+        if sp.name == "page_decode"
+    ]
+    assert decodes
+    assert sum(sp.attrs.get("runs_native", 0) for sp in decodes) == c.get(
+        "encfold_runs"
+    )
+
+
+@requires_native
+def test_chaos_decode_runs_fault_falls_back_bit_identical(
+    tmp_path, monkeypatch
+):
+    """The decode.runs chaos directive: a corrupt run stream must fail
+    closed to the row-width path — results stay bit-identical and the
+    fallback is counted, never silently wrong values."""
+    path = str(tmp_path / "chaos.parquet")
+    _write(_low_card_table(), path, row_group_size=4096)
+    monkeypatch.setenv("DEEQU_TPU_ENCODED_FOLD", "0")
+    baseline = _run_suite(path)
+    monkeypatch.setenv("DEEQU_TPU_ENCODED_FOLD", "1")
+    with faults.install("seed=3,decode.runs:1.0"):
+        with observe.tracing() as tracer:
+            assert _run_suite(path) == baseline
+    assert tracer.counters.get("encfold_chunks_fallback", 0) > 0
+    assert tracer.counters.get("encfold_chunks", 0) == 0
+
+
+@requires_native
+def test_all_null_column_suite_completeness(tmp_path, monkeypatch):
+    """An entirely-null run-folded column: Completeness and the family
+    sketches must agree with the row path (n_rows from def runs)."""
+    n = 5000
+    rng = np.random.default_rng(9)
+    t = pa.table(
+        {
+            "gone": pa.array([None] * n, type=pa.int64()),
+            "code": pa.array(rng.integers(0, 9, n).astype(np.int64)),
+        }
+    )
+    path = str(tmp_path / "nul.parquet")
+    _write(t, path, row_group_size=2048)
+
+    from deequ_tpu.analyzers import ApproxCountDistinct, Completeness, Mean
+    from deequ_tpu.runners import AnalysisRunner
+
+    def run():
+        res = (
+            AnalysisRunner()
+            .on_data(ParquetSource(path, batch_rows=4096))
+            .add_analyzers(
+                [
+                    Completeness("gone"),
+                    ApproxCountDistinct("gone"),
+                    Completeness("code"),
+                    Mean("code"),
+                ]
+            )
+            .run()
+        )
+        return {
+            repr(a): repr(m.value.get() if not m.value.is_failure else None)
+            for a, m in res.metric_map.items()
+        }
+
+    monkeypatch.setenv("DEEQU_TPU_ENCODED_FOLD", "0")
+    baseline = run()
+    monkeypatch.setenv("DEEQU_TPU_ENCODED_FOLD", "1")
+    with observe.tracing() as tracer:
+        assert run() == baseline
+    assert tracer.counters.get("encfold_cols", 0) >= 1
+    comp = [v for k, v in baseline.items() if "Completeness(gone" in k]
+    assert comp and float(comp[0].strip("'")) == 0.0
+
+
+@requires_native
+def test_classifier_names_the_disqualifying_property(tmp_path):
+    """DQ325 per-column fall-off reasons carry their class prefix:
+    analyzer (StdDev without a sketch, where filters, row-width
+    consumers), codec (dict-size fallback at write), and the approved
+    columns render on the encoded-fold plan line with the runs/dict
+    split."""
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        Correlation,
+        Mean,
+        StandardDeviation,
+    )
+    from deequ_tpu.lint.explain import explain_plan, render_explain
+
+    n = 9000
+    rng = np.random.default_rng(2)
+    t = pa.table(
+        {
+            "ok_m": pa.array(rng.integers(0, 20, n).astype(np.int64)),
+            "ok_d": pa.array(
+                rng.choice(np.round(rng.normal(0, 2, 16), 2), n)
+            ),
+            "sd": pa.array(rng.integers(0, 6, n).astype(np.int64)),
+            "uniq": pa.array(rng.integers(0, 30, n).astype(np.int64)),
+            "uniq2": pa.array(rng.integers(0, 30, n).astype(np.int64)),
+            "wh": pa.array(rng.integers(0, 7, n).astype(np.int64)),
+            "plainish": pa.array(rng.normal(size=n)),
+            "plaincodec": pa.array(rng.integers(0, 50, n).astype(np.int64)),
+        }
+    )
+    path = str(tmp_path / "cls.parquet")
+    # plaincodec is written WITHOUT dictionary pages: a codec: falloff
+    # even though its consumer (a sketch family) is memo-servable
+    _write(
+        t,
+        path,
+        row_group_size=n,
+        use_dictionary=[c for c in t.column_names if c != "plaincodec"],
+    )
+    analyzers = [
+        Mean("ok_m"),
+        ApproxCountDistinct("ok_d"),
+        StandardDeviation("sd"),
+        Correlation("uniq", "uniq2"),
+        Mean("wh", where="wh > 2"),
+        Mean("plainish"),
+        ApproxCountDistinct("plaincodec"),
+    ]
+    res = explain_plan(ParquetSource(path, batch_rows=4096), analyzers)
+    reasons = {
+        d.source: d.message
+        for d in res.diagnostics
+        if d.code == "DQ325"
+    }
+    assert "sd" in reasons and "StandardDeviation" in reasons["sd"]
+    assert "uniq" in reasons and "Correlation" in reasons["uniq"]
+    assert "uniq2" in reasons
+    assert "wh" in reasons and "where" in reasons["wh"]
+    # moments-only f64 without a sketch: nothing the memos can serve —
+    # the benefit gate names it before any codec check runs
+    assert "plainish" in reasons and "dict-size:" in reasons["plainish"]
+    assert "plaincodec" in reasons and "codec:" in reasons["plaincodec"]
+    scan = res.cost.scan_pass
+    assert scan.encfold_cols == 2
+    assert scan.encfold_moment_cols == 1
+    rendered = render_explain(res.cost)
+    assert "encoded-fold:" in rendered
+    assert "runs=1" in rendered
+
+
+@requires_native
+def test_plan_signature_keyed_on_fold_mode(tmp_path, monkeypatch):
+    """Encoded-fold states must never mix with row-fold cache entries:
+    the plan signature changes with the fold mode."""
+    from deequ_tpu.analyzers import Mean
+    from deequ_tpu.repository.states import plan_signature_for
+
+    monkeypatch.setenv("DEEQU_TPU_ENCODED_FOLD", "1")
+    assert "encfold" in runtime.fold_signature_variant()
+    on = plan_signature_for([Mean("code")])
+    monkeypatch.setenv("DEEQU_TPU_ENCODED_FOLD", "0")
+    assert "encfold" not in runtime.fold_signature_variant()
+    off = plan_signature_for([Mean("code")])
+    assert on != off
+
+
+@requires_native
+def test_kill_switch_disables_planning(tmp_path, monkeypatch):
+    """DEEQU_TPU_ENCODED_FOLD=0: the planner never approves a column
+    and the source never decodes runs."""
+    from deequ_tpu.analyzers import Mean
+    from deequ_tpu.lint.explain import explain_plan
+
+    path = str(tmp_path / "off.parquet")
+    _write(_low_card_table(4000), path)
+    monkeypatch.setenv("DEEQU_TPU_ENCODED_FOLD", "0")
+    assert not runtime.encoded_fold_enabled()
+    res = explain_plan(ParquetSource(path), [Mean("code")])
+    assert res.cost.scan_pass.encfold_cols is None

@@ -205,6 +205,32 @@ for _t in range(60):
     _valid[:] = 0
     native.read_chunk(_bad, 5, 0, 8, 1, _n, _vals, _valid)
 
+# runs-mode decode (pq_decode_chunk_runs) while instrumented: the same
+# chunk as coalesced (run_length, dict_code) + definition-level runs,
+# folded by the encfold kernels, then corrupt-run streams and
+# truncated/bit-flipped chunk variants which must fail closed without
+# reading or writing out of bounds
+_rr = native.read_chunk_runs(_chunk, 5, 0, 1, _n)
+assert _rr is not None
+_draw, _rl, _rcodes, _dl, _dv, _rnulls, _rpg, _rub, _dc = _rr
+assert _rnulls == _tbl.column("x").null_count
+_cnts = native.encfold_code_counts(_rl, _rcodes, _dc)
+assert _cnts is not None and int(_cnts.sum()) == _n - _rnulls
+assert native.encfold_def_nulls(_dl, _dv, _n) == _rnulls
+_bad_rl = _rl.copy(); _bad_rl[0] = -3
+assert native.encfold_code_counts(_bad_rl, _rcodes, _dc) is None
+_bad_rc = _rcodes.copy(); _bad_rc[0] = _dc + 7
+assert native.encfold_code_counts(_rl, _bad_rc, _dc) is None
+assert native.encfold_def_nulls(_dl, _dv, _n + 1) is None
+for _t in range(60):
+    _bad = _chunk.copy()
+    if _t % 2:
+        _bad = _bad[: int(_rngc.integers(0, len(_bad)))].copy()
+    else:
+        for _ in range(4):
+            _bad[int(_rngc.integers(0, len(_bad)))] = int(_rngc.integers(0, 256))
+    native.read_chunk_runs(_bad, 5, 0, 1, _n)
+
 # directed structural corruption while instrumented: extreme multi-byte
 # varints that byte-wise fuzzing cannot synthesize. A bit-packed group
 # count ~2^58 at bit width 32 and a dictionary count ~2^61 each used to
@@ -252,6 +278,7 @@ for _evil in [
     _vals8 = np.zeros(8, dtype=np.float64)
     _valid8 = np.zeros(1, dtype=np.uint8)
     assert native.read_chunk(_ev, 5, 0, 8, 1, 8, _vals8, _valid8) is None
+    assert native.read_chunk_runs(_ev, 5, 0, 1, 8) is None
 print("SANITIZED_OK")
 """
 

@@ -647,8 +647,14 @@ def test_reader_rule_covers_the_dispatch_module():
     sep = os.sep
     rels = set(lint.READER_FILES)
     assert f"deequ_tpu{sep}data{sep}native_reader.py" in rels
+    assert f"deequ_tpu{sep}data{sep}encfold.py" in rels
     for rel in rels:
         assert os.path.exists(os.path.join(REPO, rel)), rel
+    # and the encoded-fold module must actually be clean today: it owns
+    # the (run, code) streams end to end, so pyarrow never appears
+    assert lint.check_reader_purity(
+        os.path.join(REPO, "deequ_tpu", "data", "encfold.py")
+    ) == []
 
 
 # -- FORENSICS: no row samples on telemetry surfaces -------------------------
@@ -842,6 +848,9 @@ def test_faults_rule_covers_stage_worker_and_readahead_files():
     assert f"deequ_tpu{sep}ops{sep}pipeline.py" in rels
     assert f"deequ_tpu{sep}data{sep}source.py" in rels
     assert f"deequ_tpu{sep}data{sep}native_reader.py" in rels
+    assert f"deequ_tpu{sep}data{sep}encfold.py" in rels
+    registered = lint._registered_fault_points()
+    assert "decode.runs" in registered
     for rel in rels:
         assert os.path.exists(os.path.join(REPO, rel)), rel
 

@@ -835,6 +835,114 @@ def test_native_reader_bit_identical(layout, seed, monkeypatch, tmp_path):
     )
 
 
+# -- encoded fold on/off differential (ISSUE 20) -----------------------------
+
+
+@pytest.mark.parametrize(
+    "layout,seed",
+    [(layout, seed) for layout in ("narrow", "wide", "lineitem") for seed in range(2)],
+)
+def test_encoded_fold_bit_identical(layout, seed, monkeypatch, tmp_path):
+    """DEEQU_TPU_ENCODED_FOLD=0 (every planner-approved chunk expands
+    to row width before folding) vs =1 (eligible columns fold moments
+    over (run_len, value) streams and roll dictionary codes up into the
+    sketch families) must be BIT-identical — exact snapshot equality,
+    sketches included — across worker counts 1 vs 3, BOTH placements,
+    BOTH parquet format versions (V1/V2 data pages) and all three
+    reader codecs (uncompressed/snappy/zstd): the encoded fold changes
+    the arithmetic ORDER, never one bit of any published metric (the
+    planner only approves columns whose memo publication it can prove
+    exact). A pinned anchor check keeps the low-cardinality float role
+    a sketch consumer so at least one column is provably eligible in
+    every draw; under a tracer the fold must actually engage
+    (encfold_cols > 0, run/fallback chunk counters flowing) and the
+    per-span runs_native counts must sum to the traced encfold_runs
+    counter — the runtime twin of drift.encfold_columns staying 0."""
+    import pyarrow.parquet as pq
+
+    from deequ_tpu import observe
+    from deequ_tpu.data.table import Table as TableCls
+    from deequ_tpu.ops import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+
+    rng = np.random.default_rng(20_000 + seed)
+    table = LAYOUTS[layout](rng)
+    n = table.num_rows
+    roles = layout_roles(layout, rng)
+    checks = [random_check(rng, roles) for _ in range(int(rng.integers(1, 3)))]
+    # the low-cardinality float role with a far-out sketch constraint
+    # and no where filter: a memo-servable consumer the classifier must
+    # approve, whatever the random checks drew
+    lowcard = roles[4]
+    checks.append(
+        Check(CheckLevel.WARNING, "encfold-anchor")
+        .has_approx_count_distinct(lowcard, lambda v: v >= -1e15)
+        .has_mean(lowcard, lambda v: v >= -1e15)
+    )
+    version = "1.0" if seed % 2 == 0 else "2.6"
+    codec = ("none", "snappy", "zstd")[
+        ({"narrow": 0, "wide": 1, "lineitem": 2}[layout] + seed) % 3
+    ]
+
+    path = str(tmp_path / "encfold.parquet")
+    table.to_parquet(
+        path, row_group_size=max(64, n // 7), dictionary_encode_strings=True
+    )
+    pq.write_table(
+        pq.read_table(path),
+        path,
+        version=version,
+        compression=codec,
+        row_group_size=max(64, n // 7),
+        data_page_size=4096,
+    )
+
+    def run(encfold_env, workers_env, placement):
+        monkeypatch.setenv("DEEQU_TPU_PLACEMENT", placement)
+        monkeypatch.setenv("DEEQU_TPU_NATIVE_READER", "1")
+        monkeypatch.setenv("DEEQU_TPU_ENCODED_FOLD", encfold_env)
+        monkeypatch.setenv("DEEQU_TPU_DECODE_WORKERS", workers_env)
+        data = TableCls.scan_parquet(path, batch_rows=max(64, n // 5))
+        builder = VerificationSuite().on_data(data)
+        for check in checks:
+            builder = builder.add_check(check)
+        return suite_snapshot(builder.with_engine("single").run())
+
+    for placement in ("host", "device"):
+        baseline = run("0", "1", placement)
+        for encfold, workers in (("1", "1"), ("0", "3"), ("1", "3")):
+            assert run(encfold, workers, placement) == baseline, (
+                layout, seed, placement, encfold, workers,
+            )
+
+    host_baseline = run("0", "1", "host")
+    with observe.tracing() as tracer:
+        traced = run("1", "3", "host")
+    assert traced == host_baseline, ("tracing changed results", layout, seed)
+    assert tracer.counters.get("encfold_cols_total", 0) > 0, (
+        "encoded-fold verdict never recorded"
+    )
+    assert tracer.counters.get("encfold_cols", 0) > 0, (
+        "the anchored sketch consumer was never approved", layout, seed,
+    )
+    folded = tracer.counters.get("encfold_chunks", 0)
+    fallback = tracer.counters.get("encfold_chunks_fallback", 0)
+    assert folded + fallback > 0, (
+        "no chunk of an approved column reached the run decoder",
+        layout, seed,
+    )
+    spans = [sp for root in tracer.roots for sp in _iter_spans(root)]
+    decodes = [sp for sp in spans if sp.name == "page_decode"]
+    assert decodes, "native reader never produced a page_decode span"
+    span_runs = sum(sp.attrs.get("runs_native", 0) for sp in decodes)
+    assert span_runs == tracer.counters.get("encfold_runs", 0), (
+        "per-span run counts drifted from the traced total",
+        layout, seed,
+    )
+
+
 @pytest.mark.parametrize(
     "layout,seed",
     [("wide", 0), ("wide", 1), ("lineitem", 0), ("lineitem", 1)],

@@ -180,7 +180,10 @@ DECODE_FILES = [
 ]
 # Native-reader dispatch: pyarrow must not appear outside designated
 # `*_fallback` functions — the module owns the bytes end to end.
-READER_FILES = [os.path.join("deequ_tpu", "data", "native_reader.py")]
+READER_FILES = [
+    os.path.join("deequ_tpu", "data", "native_reader.py"),
+    os.path.join("deequ_tpu", "data", "encfold.py"),
+]
 READER_FORBIDDEN_MODULES = {"pyarrow"}
 # State serde paths: pickle is banned in any form (import, from-import,
 # attribute call) — persisted states are versioned exact-width binary.
@@ -198,6 +201,7 @@ FAULTS_FILES = [
     os.path.join("deequ_tpu", "ops", "pipeline.py"),
     os.path.join("deequ_tpu", "data", "source.py"),
     os.path.join("deequ_tpu", "data", "native_reader.py"),
+    os.path.join("deequ_tpu", "data", "encfold.py"),
     os.path.join("deequ_tpu", "service", "service.py"),
     os.path.join("deequ_tpu", "service", "admission.py"),
     os.path.join("deequ_tpu", "service", "breaker.py"),

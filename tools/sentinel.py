@@ -67,6 +67,16 @@ WATCHED_SERIES: Sequence[Tuple[str, str]] = (
     # chunks fell back to arrow (codec library vanished, writer switched
     # to an unsupported page encoding, chunk layout metadata lost)
     ("engine.reader_native_ratio", "down"),
+    # encoded-fold compression: logical values folded per (run, code)
+    # entry; a drop toward 1.0 means the data stopped run-compressing
+    # (cardinality rising, writer stopped dictionary-coding) and the
+    # run-fold kernels stopped paying
+    ("engine.encfold.run_ratio", "down"),
+    # encoded-fold containment: chunks that failed closed to the
+    # row-width path out of planned run-fold chunks; a rise means pages
+    # stopped being all-dictionary at decode (writer fallback pages,
+    # corrupt runs, dict-size overflow past the cap)
+    ("engine.encfold.fallback_ratio", "up"),
     # state-cache effectiveness: the fraction of dataset partitions whose
     # analyzer states loaded from the persistent partition-state cache
     # instead of rescanning; a drop means incremental runs stopped
